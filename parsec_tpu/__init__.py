@@ -1,0 +1,42 @@
+"""parsec_tpu — a TPU-native task-based dataflow runtime.
+
+A brand-new framework with the capabilities of PaRSEC (ICLDisco/parsec):
+applications are DAGs of micro-tasks with dataflow dependencies, described
+either through a compiled Parameterized Task Graph DSL or a dynamic
+insert-task interface, executed by a distributed runtime that overlaps
+computation with communication and manages versioned data copies across
+memory spaces. Task bodies on the compute path are pre-compiled XLA/Pallas
+executables dispatched asynchronously through JAX; distribution is expressed
+over TPU meshes with XLA collectives on ICI/DCN.
+
+Layer map (mirrors SURVEY.md §1):
+  utils/   — config (MCA params), logging, tracing        (ref L0)
+  core/    — task model, scheduling, termdet, PINS        (ref L2)
+  data/    — data copies/coherency, collections, arenas   (ref L1/L6)
+  comm/    — comm engine + remote dependency protocol     (ref L3)
+  device/  — device modules incl. the TPU module          (ref L4)
+  dsl/     — PTG compiler + DTD insert_task               (ref L5)
+  ops/     — Pallas/XLA tile kernels (gemm, potrf, ...)
+  parallel/— mesh/SPMD execution paths (shard_map)
+  tools/   — trace readers/converters                     (ref L7)
+"""
+
+__version__ = "0.1.0"
+
+from .core.context import Context, init, fini
+from .core.task import (
+    Task, TaskClass, Taskpool, Flow, Dep, Chore,
+    HOOK_DONE, HOOK_AGAIN, HOOK_ASYNC, HOOK_NEXT, HOOK_DISABLE, HOOK_ERROR,
+    FLOW_ACCESS_READ, FLOW_ACCESS_WRITE, FLOW_ACCESS_RW, FLOW_ACCESS_CTL,
+    DEV_CPU, DEV_TPU, DEV_ALL,
+)
+from .utils import mca
+
+__all__ = [
+    "Context", "init", "fini", "Task", "TaskClass", "Taskpool", "Flow", "Dep",
+    "Chore", "mca",
+    "HOOK_DONE", "HOOK_AGAIN", "HOOK_ASYNC", "HOOK_NEXT", "HOOK_DISABLE",
+    "HOOK_ERROR",
+    "FLOW_ACCESS_READ", "FLOW_ACCESS_WRITE", "FLOW_ACCESS_RW",
+    "FLOW_ACCESS_CTL", "DEV_CPU", "DEV_TPU", "DEV_ALL",
+]

@@ -1,0 +1,455 @@
+"""Runtime context, execution streams, and the scheduling state machine.
+
+Re-design of parsec/parsec.c (parsec_init, :405) + parsec/scheduling.c:
+
+* :class:`ExecutionStream` — one per worker thread (ref:
+  parsec_execution_stream_t, parsec/include/parsec/execution_stream.h:36-76).
+* :class:`Context` — process-wide state (ref: parsec_context_t,
+  execution_stream.h:117-174), with ``add_taskpool / start / wait / test``
+  mirroring parsec/runtime.h:174-388.
+* The per-thread hot loop re-creates ``__parsec_context_wait``
+  (scheduling.c:727, hot loop :789-818) including exponential backoff and
+  master-thread communication progress.
+* ``_task_progress`` re-creates ``__parsec_task_progress`` (scheduling.c:507)
+  and ``__parsec_execute`` (scheduling.c:126): prepare_input → best-device
+  selection → chore evaluate/hook → return-code dispatch
+  (DONE/AGAIN/ASYNC/NEXT/DISABLE, scheduling.c:518-566).
+* ``generic_release_deps`` re-creates the dependency-release engine
+  (parsec_release_dep_fct parsec.c:1837, parsec_release_local_OUT_dependencies
+  parsec.c:1750, parsec_update_deps_with_mask parsec.c:1657).
+
+TPU-first deviation: device chores dispatch pre-compiled XLA/Pallas
+executables asynchronously and return ``HOOK_ASYNC``; the progress loop polls
+device modules (the analogue of the reference's GPU manager thread,
+device_gpu.c:3376+) so a single host thread can keep the chip saturated —
+important because host cores are scarce relative to TPU throughput.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..utils import mca, output
+from . import pins as pins_mod
+from . import scheduler as sched_mod
+from . import termdet as termdet_mod
+from .datarepo import DataRepo
+from .task import (
+    DEV_ALL, DEV_CPU, FLOW_ACCESS_CTL, FLOW_ACCESS_WRITE,
+    HOOK_AGAIN, HOOK_ASYNC, HOOK_DISABLE, HOOK_DONE, HOOK_ERROR, HOOK_NEXT,
+    Task, TaskClass, Taskpool,
+    TASK_STATUS_COMPLETE, TASK_STATUS_HOOK, TASK_STATUS_PREPARE_INPUT,
+)
+
+mca.register("runtime_nb_cores", 0, "Worker threads (0 = autodetect)", type=int)
+mca.register("runtime_backoff_max_us", 1000, "Max starvation backoff (µs)", type=int)
+
+
+class ExecutionStream:
+    """One worker's view of the runtime (ref: execution_stream.h:36-76)."""
+
+    __slots__ = ("th_id", "vp_id", "context", "next_task", "nb_selects",
+                 "nb_executed", "prof", "rng_state")
+
+    def __init__(self, th_id: int, context: "Context", vp_id: int = 0) -> None:
+        self.th_id = th_id
+        self.vp_id = vp_id
+        self.context = context
+        self.next_task: Optional[Task] = None   # es->next_task locality slot
+        self.nb_selects = 0
+        self.nb_executed = 0
+        self.prof = None
+        self.rng_state = (th_id * 2654435761) & 0xFFFFFFFF
+
+    @property
+    def is_master(self) -> bool:
+        return self.th_id == 0  # ref: PARSEC_THREAD_IS_MASTER
+
+
+class Context:
+    """Process-wide runtime (ref: parsec_context_t + parsec_init parsec.c:405)."""
+
+    def __init__(
+        self,
+        nb_cores: Optional[int] = None,
+        scheduler: Optional[str] = None,
+        argv: Optional[List[str]] = None,
+        my_rank: int = 0,
+        nb_ranks: int = 1,
+    ) -> None:
+        if argv:
+            mca.parse_cmdline(argv)
+        if nb_cores is None:
+            nb_cores = mca.get("runtime_nb_cores", 0) or (os.cpu_count() or 1)
+        self.nb_cores = max(1, nb_cores)
+        self.my_rank = my_rank
+        self.nb_ranks = nb_ranks
+        self.pins = pins_mod.PinsManager()
+        self.streams: List[ExecutionStream] = [
+            ExecutionStream(i, self) for i in range(self.nb_cores)
+        ]
+        self.sched = sched_mod.create(scheduler)
+        self.sched.install(self)
+        for s in self.streams:
+            self.sched.flow_init(s)
+        # device registry (lazy import to avoid cycles)
+        from ..device.device import DeviceRegistry
+        self.devices = DeviceRegistry(self)
+        self.comm = None            # set by parsec_tpu.comm when distributed
+        self.profiling = None       # set by utils.trace when enabled
+        self._taskpools: Dict[int, Taskpool] = {}
+        self._active = 0
+        self._cv = threading.Condition()
+        self._started = False
+        self._finalized = False
+        self._workers: List[threading.Thread] = []
+        self._work_event = threading.Event()
+        output.debug_verbose(2, "runtime",
+                             f"context up: {self.nb_cores} streams, sched={self.sched.name}")
+
+    # ------------------------------------------------------------------ setup
+    def add_taskpool(self, tp: Taskpool) -> None:
+        """parsec_context_add_taskpool (ref: scheduling.c:865-923)."""
+        if self._finalized:
+            output.fatal("context already finalized")
+        tp.context = self
+        if tp.termdet is None:
+            termdet_mod.LocalTermdet().monitor_taskpool(tp)  # ref: scheduling.c:879-884
+        with self._cv:
+            self._taskpools[tp.taskpool_id] = tp
+            self._active += 1
+        # taskpool keeps one pending action for the enqueue itself
+        tp.addto_nb_pending_actions(1)
+        if tp.on_enqueue is not None:
+            tp.on_enqueue(tp)
+        if tp.startup_hook is not None:
+            startup = tp.startup_hook(self.streams[0], tp)
+            if startup:
+                self.schedule(startup, self.streams[0])
+        tp.termdet.taskpool_ready(tp)
+        tp.addto_nb_pending_actions(-1)
+        self._work_event.set()
+
+    def _taskpool_completed(self, tp: Taskpool) -> None:
+        with self._cv:
+            if tp.taskpool_id in self._taskpools:
+                del self._taskpools[tp.taskpool_id]
+                self._active -= 1
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------ start/wait
+    def start(self) -> None:
+        """parsec_context_start (ref: scheduling.c:968): spawn workers, wake comm."""
+        if self._started:
+            return
+        self._started = True
+        if self.comm is not None:
+            self.comm.enable()
+        for s in self.streams[1:]:
+            t = threading.Thread(target=self._worker_main, args=(s,),
+                                 name=f"parsec-tpu-worker-{s.th_id}", daemon=True)
+            self._workers.append(t)
+            t.start()
+
+    def test(self) -> bool:
+        """parsec_context_test: True when no active taskpool remains."""
+        with self._cv:
+            return self._active == 0
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """parsec_context_wait (ref: scheduling.c:994): master joins the hot loop."""
+        self.start()
+        self._progress_loop(self.streams[0],
+                            until=lambda: self._active == 0,
+                            timeout=timeout)
+        return 0
+
+    def wait_taskpool(self, tp: Taskpool, timeout: Optional[float] = None) -> bool:
+        """parsec_taskpool_wait (ref: scheduling.c:1028)."""
+        self.start()
+        self._progress_loop(self.streams[0],
+                            until=lambda: tp.completed,
+                            timeout=timeout)
+        return tp.completed
+
+    def fini(self) -> None:
+        """parsec_fini: drain and join workers."""
+        if self._finalized:
+            return
+        self.wait()
+        self._finalized = True
+        self._work_event.set()
+        for t in self._workers:
+            t.join(timeout=5.0)
+        self.devices.fini()
+        if self.comm is not None:
+            self.comm.fini()
+
+    # ------------------------------------------------------------------ scheduling
+    def schedule(self, tasks, stream: Optional[ExecutionStream] = None,
+                 distance: int = 0) -> None:
+        """__parsec_schedule (ref: scheduling.c:287)."""
+        if isinstance(tasks, Task):
+            tasks = [tasks]
+        tasks = list(tasks)
+        if not tasks:
+            return
+        stream = stream or self._current_stream()
+        self.pins.fire(pins_mod.SCHEDULE_BEGIN, stream, tasks)
+        self.sched.schedule(stream, tasks, distance)
+        self.pins.fire(pins_mod.SCHEDULE_END, stream, tasks)
+        self._work_event.set()
+
+    def _current_stream(self) -> ExecutionStream:
+        name = threading.current_thread().name
+        if name.startswith("parsec-tpu-worker-"):
+            return self.streams[int(name.rsplit("-", 1)[1])]
+        return self.streams[0]
+
+    # ------------------------------------------------------------------ hot loop
+    def _worker_main(self, stream: ExecutionStream) -> None:
+        while not self._finalized:
+            self._progress_loop(stream, until=lambda: self._active == 0)
+            # park until new work shows up
+            self._work_event.wait(timeout=0.05)
+            self._work_event.clear()
+
+    def _progress_loop(self, stream: ExecutionStream, until, timeout=None) -> None:
+        """The hot loop (ref: __parsec_context_wait scheduling.c:789-818)."""
+        misses = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        backoff_max = mca.get("runtime_backoff_max_us", 1000) / 1e6
+        while not until():
+            did_something = False
+            # master progresses communications inline (ref: scheduling.c:790-798)
+            if stream.is_master and self.comm is not None:
+                did_something |= bool(self.comm.progress())
+            # poll device modules (our analogue of the GPU manager thread)
+            did_something |= bool(self.devices.progress(stream))
+            task = stream.next_task
+            stream.next_task = None
+            distance = 0
+            if task is None:
+                self.pins.fire(pins_mod.SELECT_BEGIN, stream, None)
+                task, distance = self.sched.select(stream)
+                self.pins.fire(pins_mod.SELECT_END, stream, task)
+                stream.nb_selects += 1
+            if task is not None:
+                misses = 0
+                self._task_progress(stream, task, distance)
+                did_something = True
+            if not did_something:
+                misses += 1
+                if deadline is not None and time.monotonic() > deadline:
+                    return
+                # exponential backoff while starving (ref: scheduling.c:801-804)
+                time.sleep(min(backoff_max, 1e-6 * (1 << min(misses, 10))))
+
+    # ------------------------------------------------------------------ task FSM
+    def _task_progress(self, stream: ExecutionStream, task: Task,
+                       distance: int = 0) -> int:
+        """__parsec_task_progress (ref: scheduling.c:507)."""
+        tc = task.task_class
+        if task.status < TASK_STATUS_PREPARE_INPUT:
+            task.status = TASK_STATUS_PREPARE_INPUT
+            self.pins.fire(pins_mod.PREPARE_INPUT_BEGIN, stream, task)
+            if tc.prepare_input is not None:
+                rc = tc.prepare_input(stream, task)
+            else:
+                rc = self.generic_prepare_input(stream, task)
+            self.pins.fire(pins_mod.PREPARE_INPUT_END, stream, task)
+            if rc == HOOK_AGAIN:
+                self.schedule([task], stream, distance)
+                return rc
+        return self._execute(stream, task)
+
+    def _execute(self, stream: ExecutionStream, task: Task) -> int:
+        """__parsec_execute (ref: scheduling.c:126)."""
+        tc = task.task_class
+        task.status = TASK_STATUS_HOOK
+        device = self.devices.select_best_device(task)  # ref: device.c:100
+        task.selected_device = device
+        for chore in tc.incarnations:
+            if not (chore.device_type & task.chore_mask):
+                continue
+            if device is not None and not (chore.device_type & device.type):
+                continue
+            if chore.evaluate is not None:
+                ev = chore.evaluate(stream, task)
+                if ev == HOOK_NEXT:
+                    continue
+                if ev == HOOK_DISABLE:
+                    task.chore_mask &= ~chore.device_type
+                    continue
+            task.selected_chore = chore
+            self.pins.fire(pins_mod.EXEC_BEGIN, stream, task)
+            rc = chore.hook(stream, task)
+            stream.nb_executed += 1
+            # return-code dispatch (ref: scheduling.c:518-566)
+            if rc == HOOK_DONE:
+                self.pins.fire(pins_mod.EXEC_END, stream, task)
+                if device is not None:
+                    device.executed_tasks += 1  # async devices count in epilog
+                self.complete_task_execution(stream, task)
+                return rc
+            if rc == HOOK_ASYNC:
+                # completion arrives via complete_task_execution from a device
+                return rc
+            if rc == HOOK_AGAIN:
+                self.pins.fire(pins_mod.EXEC_END, stream, task)
+                self.schedule([task], stream, distance=1)  # __parsec_reschedule :445
+                return rc
+            if rc == HOOK_NEXT:
+                continue
+            if rc == HOOK_DISABLE:
+                task.chore_mask &= ~chore.device_type
+                continue
+            if rc == HOOK_ERROR:
+                output.fatal(f"task {task!r} hook failed")  # ref: scheduling.c:541-548
+        output.fatal(f"no runnable chore for task {task!r} "
+                     f"(chore_mask={task.chore_mask:#x})")
+        return HOOK_ERROR
+
+    def complete_task_execution(self, stream: ExecutionStream, task: Task) -> None:
+        """__parsec_complete_execution (ref: scheduling.c:469)."""
+        tc = task.task_class
+        task.status = TASK_STATUS_COMPLETE
+        self.pins.fire(pins_mod.COMPLETE_EXEC_BEGIN, stream, task)
+        if tc.prepare_output is not None:
+            tc.prepare_output(stream, task)
+        if tc.complete_execution is not None:
+            tc.complete_execution(stream, task)
+        self.pins.fire(pins_mod.RELEASE_DEPS_BEGIN, stream, task)
+        if tc.release_deps is not None:
+            tc.release_deps(stream, task)
+        else:
+            self.generic_release_deps(stream, task)
+        self.pins.fire(pins_mod.RELEASE_DEPS_END, stream, task)
+        self.pins.fire(pins_mod.COMPLETE_EXEC_END, stream, task)
+        if task.on_complete is not None:
+            task.on_complete(task)
+        task.taskpool.addto_nb_tasks(-1)
+        if tc.release_task is not None:
+            tc.release_task(stream, task)
+
+    # ------------------------------------------------------------------ deps engine
+    def generic_prepare_input(self, stream: ExecutionStream, task: Task) -> int:
+        """Generic data_lookup: resolve input copies from repos / collections
+        (the role of the generated data_lookup, ref: jdf2c.c:45)."""
+        tp = task.taskpool
+        for flow in task.task_class.flows:
+            slot = task.data[flow.flow_index]
+            if slot.data_in is not None or flow.access & FLOW_ACCESS_CTL:
+                continue
+            for dep in flow.deps_in:
+                if dep.cond is not None and not dep.cond(task.locals):
+                    continue
+                if dep.task_class is None:
+                    # direct read from a data collection (JDF: "A <- A(k)")
+                    if dep.data_ref is not None:
+                        data = dep.data_ref(task.locals)
+                        slot.data_in = data.get_copy() if hasattr(data, "get_copy") else data
+                else:
+                    plocals_seq = dep.target_locals(task.locals) if dep.target_locals else [task.locals]
+                    plocals = plocals_seq[0] if not isinstance(plocals_seq, dict) else plocals_seq
+                    pkey = dep.task_class.make_key(tp, plocals)
+                    repo = tp.repos[dep.task_class.task_class_id]
+                    entry = repo.lookup_entry(pkey) if repo is not None else None
+                    if entry is None:
+                        output.fatal(f"missing repo entry {pkey} for {task!r} flow {flow.name}")
+                    slot.data_in = entry.data[dep.flow_index]
+                    slot.source_repo_entry = entry
+                break
+        return HOOK_DONE
+
+    def generic_release_deps(self, stream: ExecutionStream, task: Task) -> None:
+        """Generic release-deps (ref: parsec_release_dep_fct parsec.c:1837).
+
+        Walks output deps, updates successor dependency masks/counters
+        (parsec.c:1657), collects newly-ready tasks into a ring and schedules
+        it (scheduling keeps the highest-priority task as ``next_task``,
+        ref: __parsec_schedule_vp scheduling.c:360).
+        """
+        tp = task.taskpool
+        tc = task.task_class
+        ready: List[Task] = []
+        # publish produced copies into this class's repo for local successors
+        repo = tp.repos[tc.task_class_id]
+        wants_repo = repo is not None and any(
+            f.access & FLOW_ACCESS_WRITE and f.deps_out for f in tc.flows)
+        entry = None
+        nb_uses = 0
+        if wants_repo:
+            entry = repo.lookup_entry_and_create(task.key)
+            for f in tc.flows:
+                if f.access & FLOW_ACCESS_WRITE:
+                    slot = task.data[f.flow_index]
+                    entry.data[f.flow_index] = slot.data_out or slot.data_in
+
+        def visit(dep, succ_locals: Dict[str, int]) -> bool:
+            succ_tc = dep.task_class
+            key = succ_tc.make_key(tp, succ_locals)
+            contribution = 1 if succ_tc.count_mode else (1 << dep.dep_index)
+            goal = (succ_tc.dependencies_goal_fn(succ_locals)
+                    if succ_tc.dependencies_goal_fn is not None else None)
+            if tp.update_deps(succ_tc, key, contribution, goal):
+                t = self.make_task(tp, succ_tc, dict(succ_locals))
+                ready.append(t)
+            return True
+
+        for flow in tc.flows:
+            for dep in flow.deps_out:
+                if dep.cond is not None and not dep.cond(task.locals):
+                    continue
+                if dep.task_class is None:
+                    continue  # write-back to memory handled by the body/copy model
+                targets = dep.target_locals(task.locals) if dep.target_locals else [task.locals]
+                if isinstance(targets, dict):
+                    targets = [targets]
+                for tl in targets:
+                    visit(dep, tl)
+                    nb_uses += 1
+        if entry is not None:
+            repo.entry_addto_usage_limit(task.key, max(nb_uses, 1))
+        # consume source repo entries (one use each)
+        for flow in tc.flows:
+            slot = task.data[flow.flow_index]
+            if slot.source_repo_entry is not None:
+                slot.source_repo_entry._repo.entry_used_once(slot.source_repo_entry.key)
+        if ready:
+            ready.sort(key=lambda t: -t.priority)
+            stream.next_task, rest = ready[0], ready[1:]
+            if rest:
+                self.schedule(rest, stream)
+
+    def make_task(self, tp: Taskpool, tc: TaskClass,
+                  locals_: Dict[str, int], priority: Optional[int] = None) -> Task:
+        if priority is None:
+            prio = tc.properties.get("priority", 0)
+            priority = prio(locals_) if callable(prio) else prio
+        return Task(tp, tc, locals_, priority)
+
+
+# ---------------------------------------------------------------------------
+# module-level convenience mirroring parsec_init/parsec_fini
+# ---------------------------------------------------------------------------
+_default_context: Optional[Context] = None
+
+
+def init(nb_cores: Optional[int] = None, argv: Optional[List[str]] = None,
+         **kw) -> Context:
+    """parsec_init equivalent (ref: parsec/parsec.c:405)."""
+    global _default_context
+    if _default_context is None or _default_context._finalized:
+        _default_context = Context(nb_cores=nb_cores, argv=argv, **kw)
+    return _default_context
+
+
+def fini() -> None:
+    global _default_context
+    if _default_context is not None:
+        _default_context.fini()
+        _default_context = None

@@ -1,0 +1,326 @@
+"""Pluggable ready-queue scheduling modules.
+
+Re-design of parsec/mca/sched (module interface: parsec/mca/sched/sched.h:210-335).
+A scheduler module provides ``install / flow_init / schedule / select / remove``;
+``schedule`` receives a *distance* hint conveying steal/locality distance exactly
+as in the reference. The module is selected at runtime through the MCA parameter
+``sched`` (ref: parsec_set_scheduler, parsec/scheduling.c:249-275).
+
+Module set mirrors the reference's (parsec/mca/sched/*):
+
+=========  =====================================================================
+``lfq``    local flat queues + hierarchical bounded buffers + work stealing
+           (default, priority 20; ref: sched_lfq_component.c:73)
+``gd``     single global dequeue (sched_gd)
+``ltq``    local tree queues (approximated: local heaps, subtree-biased steal)
+``lhq``    local hierarchical queues
+``ap``     absolute priority: one global priority heap (sched_ap)
+``pbq``    priority-based local queues + steal (sched_pbq)
+``ip``     inverse priority (sched_ip)
+``ll``     local LIFO + steal (sched_ll)
+``llp``    local LIFO with priorities (sched_llp)
+``rnd``    random global queue (sched_rnd)
+``spq``    shared priority queue (sched_spq)
+=========  =====================================================================
+
+On TPU the scheduler's job is mostly *dispatch ordering*: bodies are issued
+asynchronously to the device stream, so queue policy governs pipeline depth and
+data locality (which tiles stay HBM-resident), not CPU load balance.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..utils import mca, output
+from .task import Task
+
+mca.register("sched", "lfq", "Scheduler module (lfq|gd|ltq|lhq|ap|pbq|ip|ll|llp|rnd|spq)")
+
+
+class SchedulerModule:
+    """Module interface (ref: parsec/mca/sched/sched.h:210-335)."""
+
+    name = "base"
+    priority = 0  # component selection priority, highest wins
+
+    def install(self, context) -> None:
+        self.context = context
+
+    def flow_init(self, stream) -> None:
+        """Per-execution-stream initialization (ref: flow_init + barrier)."""
+
+    def schedule(self, stream, tasks: Iterable[Task], distance: int = 0) -> None:
+        raise NotImplementedError
+
+    def select(self, stream) -> Tuple[Optional[Task], int]:
+        """Return (task, distance-it-came-from) or (None, 0)."""
+        raise NotImplementedError
+
+    def stats(self, stream) -> Dict[str, int]:
+        return {}
+
+    def remove(self, context) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+class _LockedDeque:
+    __slots__ = ("dq", "lock")
+
+    def __init__(self) -> None:
+        self.dq: deque = deque()
+        self.lock = threading.Lock()
+
+    def push_front(self, items) -> None:
+        with self.lock:
+            self.dq.extendleft(reversed(items))
+
+    def push_back(self, items) -> None:
+        with self.lock:
+            self.dq.extend(items)
+
+    def pop_front(self):
+        with self.lock:
+            return self.dq.popleft() if self.dq else None
+
+    def pop_back(self):
+        with self.lock:
+            return self.dq.pop() if self.dq else None
+
+    def __len__(self) -> int:
+        return len(self.dq)
+
+
+class _LockedHeap:
+    """Priority heap; highest priority pops first (ties FIFO)."""
+
+    __slots__ = ("heap", "lock", "_ctr")
+
+    def __init__(self) -> None:
+        self.heap: List = []
+        self.lock = threading.Lock()
+        self._ctr = itertools.count()
+
+    def push(self, task: Task, sign: int = -1) -> None:
+        with self.lock:
+            heapq.heappush(self.heap, (sign * task.priority, next(self._ctr), task))
+
+    def pop(self) -> Optional[Task]:
+        with self.lock:
+            if not self.heap:
+                return None
+            return heapq.heappop(self.heap)[2]
+
+    def __len__(self) -> int:
+        return len(self.heap)
+
+
+# ---------------------------------------------------------------------------
+# modules
+# ---------------------------------------------------------------------------
+
+class _LocalQueuesBase(SchedulerModule):
+    """Shared shape for per-stream-queue + steal modules
+    (ref: parsec/mca/sched/sched_local_queues_utils.h)."""
+
+    lifo = False         # pop same end we push (depth-first) vs FIFO
+    use_priority = False
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._queues: Dict[int, object] = {}
+        self._order: List[int] = []
+
+    def flow_init(self, stream) -> None:
+        q = _LockedHeap() if self.use_priority else _LockedDeque()
+        self._queues[stream.th_id] = q
+        self._order.append(stream.th_id)
+
+    def _local(self, stream):
+        return self._queues[stream.th_id]
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        if not tasks:
+            return
+        # distance>0 pushes away from the hot end, as hbbuffer does in the
+        # reference (parsec/hbbuffer.c): locality hint, not a strict target.
+        q = self._local(stream)
+        if self.use_priority:
+            for t in tasks:
+                q.push(t)
+        elif distance == 0:
+            q.push_front(tasks)
+        else:
+            q.push_back(tasks)
+
+    def select(self, stream):
+        q = self._local(stream)
+        t = q.pop() if self.use_priority else q.pop_front()
+        if t is not None:
+            return t, 0
+        # work stealing: scan other streams by increasing distance
+        # (ref: lfq steals through the hierarchy of bounded buffers)
+        me = stream.th_id
+        n = len(self._order)
+        if n > 1:
+            start = self._order.index(me) if me in self._order else 0
+            for d in range(1, n):
+                victim = self._queues[self._order[(start + d) % n]]
+                t = victim.pop() if self.use_priority else victim.pop_back()
+                if t is not None:
+                    return t, d
+        return None, 0
+
+    def stats(self, stream):
+        return {"local_len": len(self._local(stream))}
+
+
+class SchedLFQ(_LocalQueuesBase):
+    """Local flat queues (default; ref: parsec/mca/sched/lfq/sched_lfq_module.c)."""
+    name = "lfq"
+    priority = 20
+
+
+class SchedLL(_LocalQueuesBase):
+    """Local LIFO (ref: sched_ll): always push and pop the front (depth-first)."""
+    name = "ll"
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        if tasks:
+            self._local(stream).push_front(tasks)
+
+
+class SchedLLP(_LocalQueuesBase):
+    """Local LIFO with priorities (ref: sched_llp, 657 LoC)."""
+    name = "llp"
+    use_priority = True
+
+
+class SchedPBQ(_LocalQueuesBase):
+    """Priority-based local queues (ref: sched_pbq)."""
+    name = "pbq"
+    use_priority = True
+
+
+class SchedLTQ(_LocalQueuesBase):
+    """Local tree queues: heap-ordered local queues, nearest-neighbor steal
+    (ref: sched_ltq uses maxheaps per thread, parsec/maxheap.c)."""
+    name = "ltq"
+    use_priority = True
+
+
+class SchedLHQ(_LocalQueuesBase):
+    """Local hierarchical queues (ref: sched_lhq): per-thread queues with
+    hierarchy-ordered stealing; hierarchy degenerates to ring order here."""
+    name = "lhq"
+
+
+class _GlobalBase(SchedulerModule):
+    def install(self, context) -> None:
+        super().install(context)
+        self._q = _LockedDeque()
+
+    def flow_init(self, stream) -> None:
+        pass
+
+
+class SchedGD(_GlobalBase):
+    """Global dequeue (ref: sched_gd)."""
+    name = "gd"
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        if not tasks:
+            return
+        if distance == 0:
+            self._q.push_front(tasks)
+        else:
+            self._q.push_back(tasks)
+
+    def select(self, stream):
+        return self._q.pop_front(), 0
+
+
+class SchedRND(_GlobalBase):
+    """Random order global queue (ref: sched_rnd)."""
+    name = "rnd"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._rng = random.Random(0xC0FFEE)
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        with self._q.lock:
+            for t in tasks:
+                if self._q.dq and self._rng.random() < 0.5:
+                    self._q.dq.insert(self._rng.randrange(len(self._q.dq) + 1), t)
+                else:
+                    self._q.dq.append(t)
+
+    def select(self, stream):
+        return self._q.pop_front(), 0
+
+
+class _GlobalHeapBase(SchedulerModule):
+    sign = -1  # -1: highest priority first
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._heap = _LockedHeap()
+
+    def flow_init(self, stream) -> None:
+        pass
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        for t in tasks:
+            self._heap.push(t, self.sign)
+
+    def select(self, stream):
+        return self._heap.pop(), 0
+
+
+class SchedAP(_GlobalHeapBase):
+    """Absolute priority (ref: sched_ap)."""
+    name = "ap"
+
+
+class SchedSPQ(_GlobalHeapBase):
+    """Shared priority queue (ref: sched_spq)."""
+    name = "spq"
+
+
+class SchedIP(_GlobalHeapBase):
+    """Inverse priority (ref: sched_ip): lowest priority first."""
+    name = "ip"
+    sign = 1
+
+
+_modules = {
+    cls.name: cls
+    for cls in (SchedLFQ, SchedGD, SchedLTQ, SchedLHQ, SchedAP, SchedPBQ,
+                SchedIP, SchedLL, SchedLLP, SchedRND, SchedSPQ)
+}
+
+
+def create(name: Optional[str] = None) -> SchedulerModule:
+    """MCA-style component selection (ref: parsec_set_scheduler, scheduling.c:249)."""
+    name = name or mca.get("sched", "lfq")
+    if name not in _modules:
+        output.fatal(f"unknown scheduler module {name!r} (have: {sorted(_modules)})")
+    return _modules[name]()
+
+
+def available() -> List[str]:
+    return sorted(_modules)

@@ -1,0 +1,434 @@
+"""DTD: dynamic task discovery — the insert-task frontend.
+
+Re-design of parsec/interfaces/dtd (insert_function.c, insert_function.h,
+insert_function_internal.h). The user (on every rank, in the same order)
+inserts tasks against *tiles*; the runtime builds the DAG on the fly from each
+tile's access chain and executes tasks as their dependencies retire:
+
+* :class:`DTDTile` — ref: parsec_dtd_tile_t (insert_function_internal.h:174-196)
+  with ``last_writer`` / reader lists driving RAW/WAR/WAW chaining
+  (WAR strategy per overlap_strategies.c: a writer waits on all readers since
+  the previous write; readers wait on the last writer).
+* :class:`DTDTaskpool` — ref: parsec_dtd_taskpool_new (insert_function.c:1513);
+  task classes are auto-created per body function + parameter profile
+  (the reference's function_h_table); flow-control **window/threshold**
+  (insert_function.h:149-157): the inserter blocks past the window and helps
+  execute until the executed count catches up.
+* ``insert_task`` — ref: parsec_dtd_insert_task (insert_function.c:3617) →
+  create/initialize (:2801), param linking (:2896), schedule-if-ready (:2963).
+* distributed mode: every rank runs the same insert sequence; tasks filtered
+  by the affinity tile's rank (owner-computes); remote edges are forwarded to
+  the comm layer (rank_sent_to bitmaps, delayed release — wired in
+  :mod:`parsec_tpu.comm.remote_dep`).
+
+TPU-first shape: bodies are *functional* — ``fn(*args) -> outputs`` returns
+fresh arrays for its WRITE flows instead of mutating in place. The same body
+runs as the CPU chore (eager, host arrays) or the TPU chore (jitted once per
+task class, dispatched asynchronously to the chip). This keeps bodies jittable
+and makes version-tracked copies natural (every write is a new buffer).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.context import Context
+from ..core.task import (
+    Chore, DEV_ALL, DEV_CPU, DEV_TPU, Flow, FLOW_ACCESS_READ, FLOW_ACCESS_RW,
+    FLOW_ACCESS_WRITE, HOOK_DONE, Task, TaskClass, Taskpool,
+)
+from ..data.collection import DataCollection
+from ..data.data import COHERENCY_OWNED, Data, data_from_array
+from ..device.tpu import TPUDevice, make_tpu_hook
+from ..utils import mca, output
+
+# access flags for insert_task args (ref: PARSEC_INPUT/OUTPUT/INOUT | AFFINITY)
+READ = FLOW_ACCESS_READ
+WRITE = FLOW_ACCESS_WRITE
+RW = FLOW_ACCESS_RW
+AFFINITY = 0x100          # ref: PARSEC_AFFINITY bit on a dtd param
+
+mca.register("dtd_window_size", 2048,
+             "Max in-flight inserted-but-not-executed tasks", type=int)
+mca.register("dtd_threshold_size", 1024,
+             "Catch-up target once the window is hit", type=int)
+
+
+class DTDTile:
+    """Ref: parsec_dtd_tile_t (insert_function_internal.h:174-196)."""
+
+    __slots__ = ("data", "key", "dc", "lock", "last_writer", "readers",
+                 "rank", "new_tile")
+
+    def __init__(self, data: Data, key: Any, dc: Optional[DataCollection],
+                 rank: int = 0, new_tile: bool = False) -> None:
+        self.data = data
+        self.key = key
+        self.dc = dc
+        self.lock = threading.Lock()
+        self.last_writer: Optional["DTDTask"] = None
+        self.readers: List["DTDTask"] = []
+        self.rank = rank
+        self.new_tile = new_tile
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<DTDTile {self.key}>"
+
+
+class DTDTask(Task):
+    """Task with runtime-discovered deps (ref: parsec_dtd_task_t)."""
+
+    __slots__ = ("deps_remaining", "successors", "completed", "lock",
+                 "arg_spec", "tiles", "rank")
+
+    def __init__(self, taskpool, task_class, priority=0) -> None:
+        super().__init__(taskpool, task_class, {}, priority)
+        # starts at 1: the insertion-in-progress guard (dropped at the end of
+        # insert_task, mirroring the count-then-activate protocol of
+        # parsec_dtd_schedule_task_if_ready, insert_function.c:2963)
+        self.deps_remaining = 1
+        self.successors: List[DTDTask] = []
+        self.completed = False
+        self.lock = threading.Lock()
+        self.arg_spec: List[Tuple[str, Any]] = []  # ('flow', i) | ('value', v)
+        self.tiles: List[Optional[DTDTile]] = []
+        self.rank = 0
+
+    def dep_satisfied(self) -> bool:
+        with self.lock:
+            self.deps_remaining -= 1
+            return self.deps_remaining == 0
+
+
+class DTDTaskClass(TaskClass):
+    """Auto-created per (body fn, param profile)
+    (ref: function_h_table, insert_function_internal.h:206-224)."""
+
+    def __init__(self, name: str, fn: Callable, flow_accesses: Tuple[int, ...],
+                 nb_values: int) -> None:
+        super().__init__(name, nb_flows=len(flow_accesses))
+        self.fn = fn
+        self.count_mode = True
+        self.flow_accesses = flow_accesses
+        for i, acc in enumerate(flow_accesses):
+            self.add_flow(Flow(f"f{i}", acc))
+        self._jit_fn = None
+        self._jit_lock = threading.Lock()
+
+    def jitted(self):
+        if self._jit_fn is None:
+            with self._jit_lock:
+                if self._jit_fn is None:
+                    import jax
+                    self._jit_fn = jax.jit(self.fn)
+        return self._jit_fn
+
+
+class DTDTaskpool(Taskpool):
+    """Ref: parsec_dtd_taskpool_new (insert_function.c:1513)."""
+
+    def __init__(self, context: Context, name: str = "dtd") -> None:
+        super().__init__(name)
+        self.ctx = context
+        self._classes: Dict[Any, DTDTaskClass] = {}
+        self._tiles: Dict[Any, DTDTile] = {}
+        self._tiles_lock = threading.Lock()
+        self.window_size = mca.get("dtd_window_size", 2048)
+        self.threshold_size = mca.get("dtd_threshold_size", 1024)
+        self.inserted = 0
+        self._executed = 0
+        self._exec_lock = threading.Lock()
+        self._open = False
+        self._touched_tiles: List[DTDTile] = []
+        context.add_taskpool(self)
+        # hold the "user may still insert" action so local termdet doesn't
+        # fire between insertions (the reference keeps the taskpool's own
+        # nb_pending_actions pinned while attached)
+        self.addto_nb_pending_actions(1)
+        self._open = True
+
+    # ------------------------------------------------------------- tiles
+    def tile_of(self, dc: DataCollection, *indices) -> DTDTile:
+        """PARSEC_DTD_TILE_OF (ref: parsec_dtd_tile_of, insert_function.c:1403)."""
+        key = (id(dc), dc.data_key(*indices))
+        with self._tiles_lock:
+            t = self._tiles.get(key)
+            if t is None:
+                data = dc.data_of(*indices)
+                t = DTDTile(data, key, dc, rank=dc.rank_of(*indices))
+                self._tiles[key] = t
+                self._touched_tiles.append(t)
+            return t
+
+    def tile_of_key(self, dc: DataCollection, key: Any) -> DTDTile:
+        tkey = (id(dc), key)
+        with self._tiles_lock:
+            t = self._tiles.get(tkey)
+            if t is None:
+                data = dc.data_of_key(key)
+                t = DTDTile(data, tkey, dc, rank=dc.rank_of_key(key))
+                self._tiles[tkey] = t
+                self._touched_tiles.append(t)
+            return t
+
+    def tile_new(self, array_or_shape, dtype=np.float32, key: Any = None) -> DTDTile:
+        """parsec_dtd_tile_new (ref: insert_function.h:239): a taskpool-lifetime
+        scratch tile not backed by any collection."""
+        if hasattr(array_or_shape, "shape"):
+            arr = np.asarray(array_or_shape)
+        else:
+            arr = np.zeros(array_or_shape, dtype=dtype)
+        data = data_from_array(arr)
+        t = DTDTile(data, ("new", data.key), None, rank=self.ctx.my_rank,
+                    new_tile=True)
+        with self._tiles_lock:
+            self._tiles[t.key] = t
+            self._touched_tiles.append(t)
+        return t
+
+    # ------------------------------------------------------------- classes
+    def _class_of(self, fn: Callable, flow_accesses: Tuple[int, ...],
+                  nb_values: int, name: Optional[str]) -> DTDTaskClass:
+        key = (fn, flow_accesses, nb_values)
+        tc = self._classes.get(key)
+        if tc is None:
+            tc = DTDTaskClass(name or getattr(fn, "__name__", "dtd_task"),
+                              fn, flow_accesses, nb_values)
+            tc.prepare_input = self._prepare_input
+            tc.release_deps = self._release_deps
+            tc.complete_execution = self._complete_execution
+            tc.add_chore(Chore(DEV_TPU, make_tpu_hook(self._tpu_submit)))
+            tc.add_chore(Chore(DEV_CPU, self._cpu_hook))
+            self.add_task_class(tc)
+            self._classes[key] = tc
+        return tc
+
+    # ------------------------------------------------------------- insert
+    def insert_task(self, fn: Callable, *args, priority: int = 0,
+                    where: int = DEV_ALL, name: Optional[str] = None) -> Optional[DTDTask]:
+        """parsec_dtd_insert_task (ref: insert_function.c:3617).
+
+        ``args``: ``(tile, access)`` tuples become data flows; anything else
+        is a by-value parameter. ``access`` may carry the AFFINITY bit to pick
+        the task's rank (default: first WRITE tile's rank).
+        """
+        if not self._open:
+            output.fatal("insert_task on a closed DTD taskpool")
+        flow_accesses: List[int] = []
+        arg_spec: List[Tuple[str, Any]] = []
+        tiles: List[DTDTile] = []
+        affinity_tile: Optional[DTDTile] = None
+        for a in args:
+            if isinstance(a, tuple) and len(a) == 2 and isinstance(a[0], DTDTile):
+                tile, acc = a
+                if acc & AFFINITY:
+                    affinity_tile = tile
+                acc &= ~AFFINITY
+                arg_spec.append(("flow", len(flow_accesses)))
+                flow_accesses.append(acc)
+                tiles.append(tile)
+            elif isinstance(a, DTDTile):
+                arg_spec.append(("flow", len(flow_accesses)))
+                flow_accesses.append(RW)
+                tiles.append(a)
+            else:
+                arg_spec.append(("value", a))
+        tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec), name)
+        task = DTDTask(self, tc, priority)
+        task.arg_spec = arg_spec
+        task.tiles = tiles
+        # owner-computes rank (ref: rank from affinity tile's rank_of_key)
+        if affinity_tile is None:
+            for t, acc in zip(tiles, flow_accesses):
+                if acc & WRITE:
+                    affinity_tile = t
+                    break
+            if affinity_tile is None and tiles:
+                affinity_tile = tiles[0]
+        task.rank = affinity_tile.rank if affinity_tile is not None else self.ctx.my_rank
+        task.locals = {"id": self.inserted}
+        self.inserted += 1
+
+        remote = task.rank != self.ctx.my_rank and self.ctx.nb_ranks > 1
+        if remote and self.ctx.comm is None:
+            remote = False  # no comm layer: run everything locally
+        # link against each tile's chain (ref: parsec_dtd_set_params_of_task
+        # insert_function.c:2896; WAR via overlap_strategies.c)
+        for tile, acc in zip(tiles, flow_accesses):
+            self._link_tile(task, tile, acc, remote)
+        if remote:
+            # the local shadow only forwards data; comm layer owns it from here
+            if self.ctx.comm is not None:
+                self.ctx.comm.dtd_remote_task(self, task)
+            self._drop_insertion_guard(task, schedule=False)
+            return task
+        self.addto_nb_tasks(1)
+        self._drop_insertion_guard(task, schedule=True)
+        # window flow control (ref: insert_function.h:149-157)
+        if self.inserted - self.executed > self.window_size:
+            target = self.inserted - self.threshold_size
+            self.ctx.start()
+            self.ctx._progress_loop(self.ctx.streams[0],
+                                    until=lambda: self.executed >= target)
+        return task
+
+    def _link_tile(self, task: DTDTask, tile: DTDTile, acc: int,
+                   remote: bool) -> None:
+        preds: List[DTDTask] = []
+        with tile.lock:
+            if acc & WRITE:
+                preds = list(tile.readers)
+                if tile.last_writer is not None:
+                    preds.append(tile.last_writer)
+                tile.last_writer = task
+                tile.readers = []
+            else:
+                if tile.last_writer is not None:
+                    preds.append(tile.last_writer)
+                tile.readers.append(task)
+        if remote:
+            return
+        seen = set()
+        for p in preds:
+            if id(p) in seen or p is task:
+                continue
+            seen.add(id(p))
+            with p.lock:
+                if not p.completed:
+                    p.successors.append(task)
+                    with task.lock:
+                        task.deps_remaining += 1
+
+    def _drop_insertion_guard(self, task: DTDTask, schedule: bool) -> None:
+        if task.dep_satisfied() and schedule:
+            # ref: parsec_dtd_schedule_task_if_ready (insert_function.c:2963)
+            self.ctx.schedule([task])
+
+    # ------------------------------------------------------------- hooks
+    def _prepare_input(self, stream, task: DTDTask) -> int:
+        for i, tile in enumerate(task.tiles):
+            copy = tile.data.newest_copy()
+            if copy is None:
+                output.fatal(f"tile {tile!r} has no valid copy for {task!r}")
+            task.data[i].data_in = copy
+        return HOOK_DONE
+
+    def _gather_args(self, task: DTDTask, flow_payloads: Sequence[Any]) -> List[Any]:
+        vals = []
+        for kind, v in task.arg_spec:
+            if kind == "flow":
+                vals.append(flow_payloads[v])
+            else:
+                vals.append(v)
+        return vals
+
+    def _apply_outputs(self, task: DTDTask, outs) -> List[Any]:
+        if outs is None:
+            outs = ()
+        elif not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return list(outs)
+
+    def _cpu_hook(self, stream, task: DTDTask) -> int:
+        tc: DTDTaskClass = task.task_class
+        payloads = [s.data_in.payload if s.data_in is not None else None
+                    for s in task.data]
+        outs = self._apply_outputs(task, tc.fn(*self._gather_args(task, payloads)))
+        oi = 0
+        for i, acc in enumerate(tc.flow_accesses):
+            if acc & WRITE:
+                tile = task.tiles[i]
+                new = outs[oi] if oi < len(outs) else payloads[i]
+                oi += 1
+                copy = task.data[i].data_in
+                host = tile.data.get_copy(0)
+                if host is None:
+                    host = tile.data.create_copy(0, new, COHERENCY_OWNED)
+                else:
+                    host.payload = new
+                tile.data.bump_version(0)
+                task.data[i].data_out = host
+        return HOOK_DONE
+
+    def _tpu_submit(self, device: TPUDevice, task: DTDTask, inputs: List[Any]):
+        """TPU chore body: call the jitted class function on device arrays.
+
+        Non-jittable bodies (non-numeric by-value args) fall back to eager;
+        JAX still dispatches the ops asynchronously.
+        """
+        tc: DTDTaskClass = task.task_class
+        vals = self._gather_args(task, inputs)
+        jittable = all(kind != "value" or isinstance(v, (int, float, np.number, np.ndarray))
+                       for kind, v in task.arg_spec)
+        fn = tc.jitted() if jittable else tc.fn
+        if jittable:
+            vals = [np.asarray(v) if isinstance(v, (int, float)) else v
+                    for v in vals]
+        outs = self._apply_outputs(task, fn(*vals))
+        # order outputs by WRITE flows (contract shared with device epilog)
+        return tuple(outs)
+
+    def _complete_execution(self, stream, task: DTDTask) -> int:
+        with self._exec_lock:
+            self._executed += 1
+        return HOOK_DONE
+
+    @property
+    def executed(self) -> int:
+        return self._executed
+
+    def _release_deps(self, stream, task: DTDTask) -> None:
+        """DTD successor release (ref: parsec_dtd_ordering_correctly,
+        insert_function_internal.h:277): flip completed, wake successors."""
+        with task.lock:
+            task.completed = True
+            succs = task.successors
+            task.successors = []
+        ready = [s for s in succs if s.dep_satisfied()]
+        if ready:
+            self.ctx.schedule(ready, stream)
+        if self.ctx.comm is not None:
+            self.ctx.comm.dtd_task_completed(self, task)
+
+    # ------------------------------------------------------------- flush/wait
+    def data_flush(self, tile: DTDTile) -> None:
+        """parsec_dtd_data_flush (ref: parsec_dtd_data_flush.c): insert a task
+        that writes the tile's newest version back home (host copy of the
+        owner)."""
+        def _flush(arr):
+            return np.asarray(arr)  # forces device->host materialization
+        self.insert_task(_flush, (tile, RW), name="dtd_flush")
+
+    def data_flush_all(self, dc: DataCollection) -> None:
+        """parsec_dtd_data_flush_all: flush every tile of ``dc`` seen so far."""
+        with self._tiles_lock:
+            tiles = [t for t in self._touched_tiles if t.dc is dc]
+        for t in tiles:
+            self.data_flush(t)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """parsec_dtd_taskpool_wait: drain everything inserted so far."""
+        self.ctx.start()
+        target = self.inserted
+        self.ctx._progress_loop(self.ctx.streams[0],
+                                until=lambda: self.executed >= target and
+                                self.nb_tasks == 0,
+                                timeout=timeout)
+        return self.executed >= target
+
+    def close(self) -> None:
+        """End of insertion: drop the open action so termination can fire."""
+        if self._open:
+            self._open = False
+            self.addto_nb_pending_actions(-1)
+
+    def __enter__(self) -> "DTDTaskpool":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.wait()
+        self.close()

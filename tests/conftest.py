@@ -1,0 +1,32 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (the reference's analogue: running every test
+under oversubscribed localhost MPI with 2-4 ranks, tests/CMakeLists.txt:1032).
+Must set the env before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env points at the TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize may have force-registered a TPU platform and
+# overridden jax_platforms at interpreter boot; override it back before any
+# backend initialization so tests never touch the TPU tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def context():
+    """A fresh single-rank runtime context per test."""
+    from parsec_tpu.core.context import Context
+    ctx = Context(nb_cores=1)
+    yield ctx
+    ctx.fini()

@@ -1,0 +1,174 @@
+"""DTD insert-task interface tests.
+
+Models the reference's tests/dsl/dtd suite (30 tests: insert interface, WAR
+chains, allreduce/reduce, data flush, new tiles, task placement, pingpong).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+from parsec_tpu.dsl.dtd import AFFINITY, DTDTaskpool, READ, RW, WRITE
+
+
+@pytest.fixture()
+def ctx():
+    c = Context(nb_cores=1)
+    yield c
+    c.fini()
+
+
+def test_simple_chain_rw(ctx):
+    """N sequential increments of one tile: RAW chain must serialize."""
+    A = TiledMatrix("A", 8, 8, 8, 8)
+    A.fill(lambda m, n: np.zeros((8, 8), np.float32))
+    tp = DTDTaskpool(ctx, "chain")
+    t = tp.tile_of(A, 0, 0)
+    N = 32
+    for _ in range(N):
+        tp.insert_task(lambda x: x + 1.0, (t, RW))
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert np.allclose(A.to_dense(), N)
+
+
+def test_war_read_then_write(ctx):
+    """Readers of version k must all run before the writer of k+1 (WAR,
+    ref: overlap_strategies.c)."""
+    A = TiledMatrix("A", 4, 4, 4, 4)
+    A.fill(lambda m, n: np.full((4, 4), 7.0, np.float32))
+    tp = DTDTaskpool(ctx, "war")
+    t = tp.tile_of(A, 0, 0)
+    seen = []
+
+    def reader(x):
+        seen.append(float(np.asarray(x)[0, 0]))
+        return None
+
+    def writer(x):
+        return x * 0.0
+
+    for _ in range(4):
+        tp.insert_task(reader, (t, READ))
+    tp.insert_task(writer, (t, RW))
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert seen == [7.0] * 4
+    assert np.allclose(A.to_dense(), 0.0)
+
+
+def test_value_args_and_new_tile(ctx):
+    """By-value params + parsec_dtd_tile_new scratch tiles."""
+    tp = DTDTaskpool(ctx, "vals")
+    t = tp.tile_new((4, 4), np.float32)
+    tp.insert_task(lambda x, a, b: x + a * b, (t, RW), 3.0, 4.0)
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert np.allclose(np.asarray(t.data.newest_copy().payload), 12.0)
+
+
+def test_reduction_tree(ctx):
+    """Pairwise reduction over 8 tiles (ref: dtd_test_allreduce shape)."""
+    A = TiledMatrix("A", 32, 4, 4, 4)
+    A.fill(lambda m, n: np.full((4, 4), float(m), np.float32))
+    tp = DTDTaskpool(ctx, "reduce")
+    tiles = [tp.tile_of(A, m, 0) for m in range(8)]
+
+    def add(dst, src):
+        return dst + src
+
+    stride = 1
+    while stride < 8:
+        for i in range(0, 8, 2 * stride):
+            tp.insert_task(add, (tiles[i], RW), (tiles[i + stride], READ))
+        stride *= 2
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert np.allclose(np.asarray(tiles[0].data.newest_copy().payload),
+                       sum(range(8)))
+
+
+def test_tiled_gemm_dtd(ctx):
+    """Tiled GEMM through insert_task vs numpy (the reference's
+    dtd_test_simple_gemm.c correctness check)."""
+    MT = NT = KT = 3
+    TS = 16
+    rng = np.random.default_rng(0)
+    A = TiledMatrix("A", MT * TS, KT * TS, TS, TS)
+    B = TiledMatrix("B", KT * TS, NT * TS, TS, TS)
+    C = TiledMatrix("C", MT * TS, NT * TS, TS, TS)
+    A.fill(lambda m, n: rng.standard_normal((TS, TS)).astype(np.float32))
+    B.fill(lambda m, n: rng.standard_normal((TS, TS)).astype(np.float32))
+    C.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+
+    tp = DTDTaskpool(ctx, "gemm")
+
+    def gemm(c, a, b):
+        return c + a @ b
+
+    for m in range(MT):
+        for n in range(NT):
+            tc = tp.tile_of(C, m, n)
+            for k in range(KT):
+                tp.insert_task(gemm, (tc, RW | AFFINITY),
+                               (tp.tile_of(A, m, k), READ),
+                               (tp.tile_of(B, k, n), READ))
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    ref = A.to_dense() @ B.to_dense()
+    assert np.allclose(C.to_dense(), ref, atol=1e-3)
+
+
+def test_window_flow_control(ctx):
+    """Insertion beyond the window blocks and helps execute
+    (ref: parsec_dtd_window_size)."""
+    tp = DTDTaskpool(ctx, "window")
+    tp.window_size = 8
+    tp.threshold_size = 4
+    t = tp.tile_new((2, 2), np.float32)
+    for _ in range(64):
+        tp.insert_task(lambda x: x + 1.0, (t, RW))
+        assert tp.inserted - tp.executed <= tp.window_size + 1
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert np.allclose(np.asarray(t.data.newest_copy().payload), 64.0)
+
+
+def test_two_collections_block_cyclic(ctx):
+    """tile_of over a 2D block-cyclic collection on 1 rank behaves densely."""
+    A = TwoDimBlockCyclic("A", 64, 64, 16, 16, P=1, Q=1)
+    A.fill(lambda m, n: np.full((16, 16), m * 10.0 + n, np.float32))
+    tp = DTDTaskpool(ctx, "bc")
+    for m in range(A.mt):
+        for n in range(A.nt):
+            tp.insert_task(lambda x: x * 2.0, (tp.tile_of(A, m, n), RW))
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    for m in range(A.mt):
+        for n in range(A.nt):
+            assert np.allclose(
+                np.asarray(A.data_of(m, n).newest_copy().payload),
+                2 * (m * 10.0 + n))
+
+
+def test_flush_all(ctx):
+    """data_flush_all writes tiles home in dependency order."""
+    A = TiledMatrix("A", 8, 8, 4, 4)
+    A.fill(lambda m, n: np.ones((4, 4), np.float32))
+    tp = DTDTaskpool(ctx, "flush")
+    for m in range(2):
+        for n in range(2):
+            tp.insert_task(lambda x: x + 41.0, (tp.tile_of(A, m, n), RW))
+    tp.data_flush_all(A)
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert np.allclose(A.to_dense(), 42.0)

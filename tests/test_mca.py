@@ -1,0 +1,53 @@
+"""MCA parameter registry tests (ref: parsec/utils/mca_param.c semantics)."""
+
+import os
+
+from parsec_tpu.utils.mca import ParamRegistry
+
+
+def test_register_default():
+    r = ParamRegistry()
+    r.register("x", 42, "answer", type=int)
+    assert r.get("x") == 42
+
+
+def test_priority_order(tmp_path, monkeypatch):
+    r = ParamRegistry()
+    r.register("sched_q", "lfq", "queue")
+    # file < env < cmdline < explicit
+    f = tmp_path / "params.conf"
+    f.write_text("sched_q = fromfile  # comment\n\n# full comment\n")
+    r.read_paramfile(str(f))
+    assert r.get("sched_q") == "fromfile"
+    monkeypatch.setenv("PARSEC_MCA_sched_q", "fromenv")
+    assert r.get("sched_q") == "fromenv"
+    rest = r.parse_cmdline(["prog", "--mca", "sched_q", "fromcli", "arg"])
+    assert rest == ["prog", "arg"]
+    assert r.get("sched_q") == "fromcli"
+    r.set("sched_q", "explicit")
+    assert r.get("sched_q") == "explicit"
+    r.unset("sched_q")
+    assert r.get("sched_q") == "fromcli"
+
+
+def test_type_coercion(monkeypatch):
+    r = ParamRegistry()
+    r.register("flag", False, type=bool)
+    monkeypatch.setenv("PARSEC_MCA_flag", "true")
+    assert r.get("flag") is True
+    monkeypatch.setenv("PARSEC_MCA_flag", "0")
+    assert r.get("flag") is False
+    r.register("n", 1, type=int)
+    monkeypatch.setenv("PARSEC_MCA_n", "7")
+    assert r.get("n") == 7
+
+
+def test_on_change_and_help():
+    r = ParamRegistry()
+    r.register("watched", 1, "help me", type=int)
+    seen = []
+    r.on_change("watched", seen.append)
+    r.set("watched", 5)
+    assert seen == [5]
+    assert "watched" in r.help_text()
+    assert "help me" in r.help_text()
