@@ -103,28 +103,42 @@ class DTDTask(Task):
             return self.deps_remaining == 0
 
 
+#: process-wide jit cache keyed by the body function object, so the same body
+#: used across many taskpools compiles exactly once (jax.jit caches traces on
+#: the wrapper object — a fresh wrapper per task class would retrace).
+_jit_cache: Dict[Any, Any] = {}
+_jit_cache_lock = threading.Lock()
+
+
+def _jitted(fn: Callable):
+    j = _jit_cache.get(fn)
+    if j is None:
+        with _jit_cache_lock:
+            j = _jit_cache.get(fn)
+            if j is None:
+                import jax
+                j = jax.jit(fn)
+                _jit_cache[fn] = j
+    return j
+
+
 class DTDTaskClass(TaskClass):
     """Auto-created per (body fn, param profile)
     (ref: function_h_table, insert_function_internal.h:206-224)."""
 
     def __init__(self, name: str, fn: Callable, flow_accesses: Tuple[int, ...],
-                 nb_values: int) -> None:
+                 nb_values: int, jit_ok: bool = True) -> None:
         super().__init__(name, nb_flows=len(flow_accesses))
         self.fn = fn
         self.count_mode = True
         self.flow_accesses = flow_accesses
+        #: False for side-effectful bodies (callbacks, host I/O): run eagerly
+        self.jit_ok = jit_ok
         for i, acc in enumerate(flow_accesses):
             self.add_flow(Flow(f"f{i}", acc))
-        self._jit_fn = None
-        self._jit_lock = threading.Lock()
 
     def jitted(self):
-        if self._jit_fn is None:
-            with self._jit_lock:
-                if self._jit_fn is None:
-                    import jax
-                    self._jit_fn = jax.jit(self.fn)
-        return self._jit_fn
+        return _jitted(self.fn)
 
 
 class DTDTaskpool(Taskpool):
@@ -191,12 +205,13 @@ class DTDTaskpool(Taskpool):
 
     # ------------------------------------------------------------- classes
     def _class_of(self, fn: Callable, flow_accesses: Tuple[int, ...],
-                  nb_values: int, name: Optional[str]) -> DTDTaskClass:
-        key = (fn, flow_accesses, nb_values)
+                  nb_values: int, name: Optional[str],
+                  jit_ok: bool = True) -> DTDTaskClass:
+        key = (fn, flow_accesses, nb_values, jit_ok)
         tc = self._classes.get(key)
         if tc is None:
             tc = DTDTaskClass(name or getattr(fn, "__name__", "dtd_task"),
-                              fn, flow_accesses, nb_values)
+                              fn, flow_accesses, nb_values, jit_ok=jit_ok)
             tc.prepare_input = self._prepare_input
             tc.release_deps = self._release_deps
             tc.complete_execution = self._complete_execution
@@ -208,7 +223,8 @@ class DTDTaskpool(Taskpool):
 
     # ------------------------------------------------------------- insert
     def insert_task(self, fn: Callable, *args, priority: int = 0,
-                    where: int = DEV_ALL, name: Optional[str] = None) -> Optional[DTDTask]:
+                    where: int = DEV_ALL, name: Optional[str] = None,
+                    jit: bool = True) -> Optional[DTDTask]:
         """parsec_dtd_insert_task (ref: insert_function.c:3617).
 
         ``args``: ``(tile, access)`` tuples become data flows; anything else
@@ -236,7 +252,8 @@ class DTDTaskpool(Taskpool):
                 tiles.append(a)
             else:
                 arg_spec.append(("value", a))
-        tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec), name)
+        tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec), name,
+                            jit_ok=jit)
         task = DTDTask(self, tc, priority)
         task.arg_spec = arg_spec
         task.tiles = tiles
@@ -332,11 +349,35 @@ class DTDTaskpool(Taskpool):
             outs = (outs,)
         return list(outs)
 
+    def _jittable(self, task: DTDTask) -> bool:
+        if not task.task_class.jit_ok:
+            return False
+        return all(kind != "value" or isinstance(v, (int, float, np.number, np.ndarray))
+                   for kind, v in task.arg_spec)
+
     def _cpu_hook(self, stream, task: DTDTask) -> int:
         tc: DTDTaskClass = task.task_class
         payloads = [s.data_in.payload if s.data_in is not None else None
                     for s in task.data]
-        outs = self._apply_outputs(task, tc.fn(*self._gather_args(task, payloads)))
+        vals = self._gather_args(task, payloads)
+        # jit the body on the host backend too: eager per-op dispatch is the
+        # dominant cost for jax-expressed bodies (compiled once per class)
+        if self._jittable(task):
+            fn = tc.jitted()
+            vals = [np.asarray(v) if isinstance(v, (int, float)) else v
+                    for v in vals]
+            import jax
+            try:
+                cpu = jax.local_devices(backend="cpu")[0]
+            except Exception:
+                cpu = None
+            if cpu is not None:
+                with jax.default_device(cpu):
+                    outs = self._apply_outputs(task, fn(*vals))
+            else:
+                outs = self._apply_outputs(task, fn(*vals))
+        else:
+            outs = self._apply_outputs(task, tc.fn(*vals))
         oi = 0
         for i, acc in enumerate(tc.flow_accesses):
             if acc & WRITE:
@@ -361,8 +402,7 @@ class DTDTaskpool(Taskpool):
         """
         tc: DTDTaskClass = task.task_class
         vals = self._gather_args(task, inputs)
-        jittable = all(kind != "value" or isinstance(v, (int, float, np.number, np.ndarray))
-                       for kind, v in task.arg_spec)
+        jittable = self._jittable(task)
         fn = tc.jitted() if jittable else tc.fn
         if jittable:
             vals = [np.asarray(v) if isinstance(v, (int, float)) else v
@@ -400,7 +440,7 @@ class DTDTaskpool(Taskpool):
         owner)."""
         def _flush(arr):
             return np.asarray(arr)  # forces device->host materialization
-        self.insert_task(_flush, (tile, RW), name="dtd_flush")
+        self.insert_task(_flush, (tile, RW), name="dtd_flush", jit=False)
 
     def data_flush_all(self, dc: DataCollection) -> None:
         """parsec_dtd_data_flush_all: flush every tile of ``dc`` seen so far."""

@@ -51,7 +51,7 @@ def test_war_read_then_write(ctx):
         return x * 0.0
 
     for _ in range(4):
-        tp.insert_task(reader, (t, READ))
+        tp.insert_task(reader, (t, READ), jit=False)
     tp.insert_task(writer, (t, RW))
     tp.wait()
     tp.close()
