@@ -61,7 +61,8 @@ class DTDTile:
     """Ref: parsec_dtd_tile_t (insert_function_internal.h:174-196)."""
 
     __slots__ = ("data", "key", "dc", "lock", "last_writer", "readers",
-                 "rank", "new_tile")
+                 "rank", "new_tile", "wcount", "writer_rank",
+                 "last_writer_version")
 
     def __init__(self, data: Data, key: Any, dc: Optional[DataCollection],
                  rank: int = 0, new_tile: bool = False) -> None:
@@ -73,6 +74,12 @@ class DTDTile:
         self.readers: List["DTDTask"] = []
         self.rank = rank
         self.new_tile = new_tile
+        #: logical write sequence number, identical on every rank because all
+        #: ranks replay the same insert sequence (the basis remote transfers
+        #: are keyed on, standing for the reference's output version tracking)
+        self.wcount = 0
+        self.writer_rank = rank      # rank holding the newest version
+        self.last_writer_version = 0
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"<DTDTile {self.key}>"
@@ -82,7 +89,8 @@ class DTDTask(Task):
     """Task with runtime-discovered deps (ref: parsec_dtd_task_t)."""
 
     __slots__ = ("deps_remaining", "successors", "completed", "lock",
-                 "arg_spec", "tiles", "rank")
+                 "arg_spec", "tiles", "rank", "pending_inputs",
+                 "remote_sends")
 
     def __init__(self, taskpool, task_class, priority=0) -> None:
         super().__init__(taskpool, task_class, {}, priority)
@@ -96,6 +104,11 @@ class DTDTask(Task):
         self.arg_spec: List[Tuple[str, Any]] = []  # ('flow', i) | ('value', v)
         self.tiles: List[Optional[DTDTile]] = []
         self.rank = 0
+        #: flow_index -> payload delivered by the comm engine (exact-version
+        #: remote inputs override newest_copy resolution)
+        self.pending_inputs: Dict[int, Any] = {}
+        #: id(tile) -> (tile, version, {dst ranks}) — the rank_sent_to bitmap
+        self.remote_sends: Dict[int, Tuple] = {}
 
     def dep_satisfied(self) -> bool:
         with self.lock:
@@ -153,10 +166,16 @@ class DTDTaskpool(Taskpool):
         self.window_size = mca.get("dtd_window_size", 2048)
         self.threshold_size = mca.get("dtd_threshold_size", 1024)
         self.inserted = 0
+        self.local_inserted = 0   # tasks this rank actually executes
         self._executed = 0
         self._exec_lock = threading.Lock()
         self._open = False
         self._touched_tiles: List[DTDTile] = []
+        self._new_tile_count = 0
+        if context.comm is not None:
+            # distributed: global termination detection + name-keyed registry
+            context.comm.fourcounter.monitor_taskpool(self)
+            context.comm.register_taskpool(self)
         context.add_taskpool(self)
         # hold the "user may still insert" action so local termdet doesn't
         # fire between insertions (the reference keeps the taskpool's own
@@ -167,7 +186,7 @@ class DTDTaskpool(Taskpool):
     # ------------------------------------------------------------- tiles
     def tile_of(self, dc: DataCollection, *indices) -> DTDTile:
         """PARSEC_DTD_TILE_OF (ref: parsec_dtd_tile_of, insert_function.c:1403)."""
-        key = (id(dc), dc.data_key(*indices))
+        key = (dc.name, dc.data_key(*indices))
         with self._tiles_lock:
             t = self._tiles.get(key)
             if t is None:
@@ -178,7 +197,7 @@ class DTDTaskpool(Taskpool):
             return t
 
     def tile_of_key(self, dc: DataCollection, key: Any) -> DTDTile:
-        tkey = (id(dc), key)
+        tkey = (dc.name, key)
         with self._tiles_lock:
             t = self._tiles.get(tkey)
             if t is None:
@@ -196,8 +215,9 @@ class DTDTaskpool(Taskpool):
         else:
             arr = np.zeros(array_or_shape, dtype=dtype)
         data = data_from_array(arr)
-        t = DTDTile(data, ("new", data.key), None, rank=self.ctx.my_rank,
-                    new_tile=True)
+        self._new_tile_count += 1
+        t = DTDTile(data, ("new", self.name, self._new_tile_count), None,
+                    rank=self.ctx.my_rank, new_tile=True)
         with self._tiles_lock:
             self._tiles[t.key] = t
             self._touched_tiles.append(t)
@@ -269,43 +289,72 @@ class DTDTaskpool(Taskpool):
         task.locals = {"id": self.inserted}
         self.inserted += 1
 
-        remote = task.rank != self.ctx.my_rank and self.ctx.nb_ranks > 1
-        if remote and self.ctx.comm is None:
-            remote = False  # no comm layer: run everything locally
+        distributed = self.ctx.comm is not None and self.ctx.nb_ranks > 1
+        remote = distributed and task.rank != self.ctx.my_rank
         # link against each tile's chain (ref: parsec_dtd_set_params_of_task
-        # insert_function.c:2896; WAR via overlap_strategies.c)
-        for tile, acc in zip(tiles, flow_accesses):
-            self._link_tile(task, tile, acc, remote)
+        # insert_function.c:2896; WAR via overlap_strategies.c). In
+        # distributed mode every rank replays the same sequence, so the
+        # version bookkeeping below is globally consistent without messages.
+        for fi, (tile, acc) in enumerate(zip(tiles, flow_accesses)):
+            self._link_tile(task, tile, acc, fi, remote, distributed)
         if remote:
-            # the local shadow only forwards data; comm layer owns it from here
-            if self.ctx.comm is not None:
-                self.ctx.comm.dtd_remote_task(self, task)
+            # shadow task: executes elsewhere; local role is only data routing
+            self.ctx.comm.dtd_remote_task(self, task)
             self._drop_insertion_guard(task, schedule=False)
             return task
         self.addto_nb_tasks(1)
+        self.local_inserted += 1
         self._drop_insertion_guard(task, schedule=True)
         # window flow control (ref: insert_function.h:149-157)
-        if self.inserted - self.executed > self.window_size:
-            target = self.inserted - self.threshold_size
+        if self.local_inserted - self.executed > self.window_size:
+            target = self.local_inserted - self.threshold_size
             self.ctx.start()
             self.ctx._progress_loop(self.ctx.streams[0],
                                     until=lambda: self.executed >= target)
         return task
 
     def _link_tile(self, task: DTDTask, tile: DTDTile, acc: int,
-                   remote: bool) -> None:
+                   flow_index: int, remote: bool, distributed: bool) -> None:
+        my = self.ctx.my_rank
         preds: List[DTDTask] = []
         with tile.lock:
+            read_version = tile.wcount
+            src_rank = tile.writer_rank
+            if acc & READ or not (acc & WRITE):
+                # RAW: predecessor is the last writer (local chain) or a
+                # remote version expectation / outbound send
+                if tile.last_writer is not None and \
+                        (not distributed or tile.last_writer.rank == my):
+                    preds.append(tile.last_writer)
+                if not remote:
+                    tile.readers.append(task)
             if acc & WRITE:
-                preds = list(tile.readers)
-                if tile.last_writer is not None:
+                # WAR: wait on local readers since the previous write; WAW on
+                # the local last writer (remote ones are covered by the
+                # version expectation on the READ side of RW, or need no
+                # local ordering at all)
+                for r in tile.readers:
+                    if not distributed or r.rank == my:
+                        preds.append(r)
+                if tile.last_writer is not None and \
+                        (not distributed or tile.last_writer.rank == my) and \
+                        tile.last_writer not in preds:
                     preds.append(tile.last_writer)
                 tile.last_writer = task
                 tile.readers = []
-            else:
-                if tile.last_writer is not None:
-                    preds.append(tile.last_writer)
-                tile.readers.append(task)
+                tile.wcount += 1
+                tile.last_writer_version = tile.wcount
+                tile.writer_rank = task.rank
+        if distributed:
+            comm = self.ctx.comm
+            needs_data = bool(acc & READ)   # pure WRITE flows ship nothing
+            if not remote and needs_data and src_rank != my:
+                # local consumer of a remotely-produced version
+                comm.expect(self, task, tile, read_version, src_rank,
+                            flow_index)
+            elif remote and needs_data and src_rank == my:
+                # remote consumer of a locally-held/produced version
+                comm.note_send(self, tile, read_version, task.rank)
         if remote:
             return
         seen = set()
@@ -327,6 +376,15 @@ class DTDTaskpool(Taskpool):
     # ------------------------------------------------------------- hooks
     def _prepare_input(self, stream, task: DTDTask) -> int:
         for i, tile in enumerate(task.tiles):
+            pend = task.pending_inputs.pop(i, None)
+            if pend is not None:
+                # remote exact-version payload (may differ from newest_copy
+                # when versions raced in through the network out of order);
+                # an unattached copy: carries the right Data for write-back
+                # without perturbing newest_copy resolution
+                from ..data.data import DataCopy
+                task.data[i].data_in = DataCopy(tile.data, 0, pend)
+                continue
             copy = tile.data.newest_copy()
             if copy is None:
                 output.fatal(f"tile {tile!r} has no valid copy for {task!r}")
@@ -450,9 +508,9 @@ class DTDTaskpool(Taskpool):
             self.data_flush(t)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        """parsec_dtd_taskpool_wait: drain everything inserted so far."""
+        """parsec_dtd_taskpool_wait: drain everything this rank executes."""
         self.ctx.start()
-        target = self.inserted
+        target = self.local_inserted
         self.ctx._progress_loop(self.ctx.streams[0],
                                 until=lambda: self.executed >= target and
                                 self.nb_tasks == 0,
