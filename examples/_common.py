@@ -1,0 +1,14 @@
+"""Shared example plumbing: path setup + CPU fallback off-pod."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def maybe_force_cpu() -> None:
+    """Examples run anywhere: fall back to the CPU backend when no healthy
+    accelerator is reachable (EXAMPLES_CPU=1 forces it)."""
+    if os.environ.get("EXAMPLES_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")

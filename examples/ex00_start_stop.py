@@ -1,0 +1,17 @@
+"""Ex00: runtime lifecycle — init, start, wait, fini.
+
+(Reference analogue: examples/Ex00_StartStop.c)
+"""
+from _common import maybe_force_cpu
+
+def main():
+    maybe_force_cpu()
+    import parsec_tpu as pt
+    ctx = pt.init(nb_cores=1)
+    ctx.start()
+    ctx.wait()           # no taskpools: returns immediately
+    pt.fini()
+    print("ex00: context lifecycle OK")
+
+if __name__ == "__main__":
+    main()

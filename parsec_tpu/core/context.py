@@ -378,16 +378,19 @@ class Context:
         ready: List[Task] = []
         # publish produced copies into this class's repo for local successors
         repo = tp.repos[tc.task_class_id]
+        # publish every flow that local successors will consume — written
+        # flows and forwarded reads alike (count_deps_fct role, parsec.c:1448)
         wants_repo = repo is not None and any(
-            f.access & FLOW_ACCESS_WRITE and f.deps_out for f in tc.flows)
+            any(d.task_class is not None for d in f.deps_out) for f in tc.flows)
         entry = None
         nb_uses = 0
         if wants_repo:
             entry = repo.lookup_entry_and_create(task.key)
             for f in tc.flows:
-                if f.access & FLOW_ACCESS_WRITE:
+                if f.deps_out and not (f.access & FLOW_ACCESS_CTL):
                     slot = task.data[f.flow_index]
-                    entry.data[f.flow_index] = slot.data_out or slot.data_in
+                    out = slot.data_out if slot.data_out is not None else slot.data_in
+                    entry.data[f.flow_index] = out
 
         def visit(dep, succ_locals: Dict[str, int]) -> bool:
             succ_tc = dep.task_class

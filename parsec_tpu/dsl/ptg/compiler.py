@@ -1,0 +1,496 @@
+"""PTG compiler: ProgramSpec → runtime task classes.
+
+Stands where the reference's jdf2c.c code generator stands (SURVEY §2.5:
+structure/symbols/flows/deps/startup/init/ctor/keys/hooks/data_lookup/
+release_deps/iterate_successors), but instead of emitting C against the
+task-class contract it *builds* :class:`parsec_tpu.core.task.TaskClass`
+objects directly:
+
+* parameter ranges → the startup enumerator counting the task space and
+  seeding ready tasks (the generated startup/internal_init, jdf2c.c:3047,3455)
+* guarded in-deps → ``prepare_input`` (the generated data_lookup, jdf2c.c:45)
+  + per-task dependency goals (count mode — the DYNAMIC_HASH_TABLE dep mode)
+* guarded out-deps → ``Dep`` descriptors consumed by the generic
+  release-deps engine (iterate_successors, jdf2c.c:47)
+* BODY blocks → chores: the body text becomes a Python function of
+  (params..., flows...) returning its written flows, jitted once per class —
+  a PTG body IS an XLA executable on TPU (the BODY[type=TPU] goal of
+  BASELINE.json)
+* memory out-deps → write-back to the data collection at completion
+
+Python expressions are compiled once at class-build time and evaluated
+against task locals + user globals.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...core.context import Context
+from ...core.datarepo import DataRepo
+from ...core.task import (
+    Chore, DEV_CPU, DEV_TPU, Dep, Flow, FLOW_ACCESS_CTL, FLOW_ACCESS_READ,
+    FLOW_ACCESS_RW, FLOW_ACCESS_WRITE, HOOK_DONE, Task, TaskClass, Taskpool,
+)
+from ...data.data import COHERENCY_OWNED, DataCopy
+from ...device.tpu import make_tpu_hook
+from ...utils import output
+from . import parser as P
+
+_ACCESS_MAP = {
+    P.FLOW_READ: FLOW_ACCESS_READ,
+    P.FLOW_WRITE: FLOW_ACCESS_WRITE,
+    P.FLOW_RW: FLOW_ACCESS_RW,
+    P.FLOW_CTL: FLOW_ACCESS_CTL,
+}
+
+
+def _payload_of(v: Any) -> Any:
+    return v.payload if isinstance(v, DataCopy) else v
+
+
+class _Expr:
+    """One compiled Python expression evaluated against task locals."""
+
+    __slots__ = ("code", "src")
+    is_range = False
+
+    def __init__(self, src: str) -> None:
+        self.src = src = src.strip()
+        try:
+            self.code = compile(src, f"<ptg:{src}>", "eval")
+        except SyntaxError as e:
+            raise P.PTGSyntaxError(f"bad expression {src!r}: {e}") from e
+
+    def __call__(self, env: Dict[str, Any]) -> Any:
+        return eval(self.code, env)  # noqa: S307 - the DSL is code by design
+
+    def values(self, env: Dict[str, Any]) -> List[int]:
+        return [int(self(env))]
+
+
+class _RangeExpr:
+    """A JDF range endpoint index ``lo .. hi`` — broadcast/gather fan-out
+    (e.g. ``-> Y WORK(0 .. W-1)`` multicasts one output to many tasks)."""
+
+    __slots__ = ("lo", "hi")
+    is_range = True
+
+    def __init__(self, lo: str, hi: str) -> None:
+        self.lo = _Expr(lo)
+        self.hi = _Expr(hi)
+
+    def values(self, env: Dict[str, Any]) -> List[int]:
+        return list(range(int(self.lo(env)), int(self.hi(env)) + 1))
+
+
+def _index_expr(src: str):
+    # top-level '..' only (not inside parens/brackets)
+    depth = 0
+    for i, c in enumerate(src):
+        if c in "([":
+            depth += 1
+        elif c in ")]":
+            depth -= 1
+        elif c == "." and depth == 0 and src[i:i+2] == ".." and src[i:i+3] != "...":
+            return _RangeExpr(src[:i], src[i+2:])
+    return _Expr(src)
+
+
+class PTGTaskpool(Taskpool):
+    """A taskpool instantiated from a PTG program."""
+
+    def __init__(self, program: "PTGProgram", ctx: Context,
+                 globals_: Dict[str, Any],
+                 collections: Dict[str, Any],
+                 name: Optional[str] = None) -> None:
+        super().__init__(name or program.spec.name)
+        self.program = program
+        self.ctx = ctx
+        self.env_base: Dict[str, Any] = {"__builtins__": {}}
+        self.env_base.update({
+            "min": min, "max": max, "abs": abs, "range": range, "len": len,
+            "int": int, "divmod": divmod,
+        })
+        self.env_base.update(globals_)
+        self.collections = collections
+        missing = [g for g in program.spec.globals
+                   if g not in globals_ and g not in collections]
+        if missing:
+            output.fatal(f"PTG taskpool {self.name}: missing globals {missing}")
+        self._build()
+
+    # ------------------------------------------------------------------ build
+    def _build(self) -> None:
+        spec = self.program.spec
+        self._classes: Dict[str, TaskClass] = {}
+        # pass 1: shells
+        for tcs in spec.task_classes:
+            tc = TaskClass(tcs.name, nb_locals=len(tcs.params))
+            tc.count_mode = True
+            for fs in tcs.flows:
+                tc.add_flow(Flow(fs.name, _ACCESS_MAP[fs.access]))
+            tc.make_key = (lambda params: (
+                lambda tp, loc: tuple(loc[p] for p in params)
+            ))(tcs.params)
+            self.add_task_class(tc)
+            self.repos[tc.task_class_id] = DataRepo(tc.nb_flows, tcs.name)
+            self._classes[tcs.name] = tc
+        # pass 2: deps, goals, hooks
+        for tcs in spec.task_classes:
+            self._build_class(tcs, self._classes[tcs.name])
+        self.startup_hook = self._startup
+
+    def _env(self, locals_: Dict[str, int]) -> Dict[str, Any]:
+        env = dict(self.env_base)
+        env.update(locals_)
+        return env
+
+    def _build_class(self, tcs: P.TaskClassSpec, tc: TaskClass) -> None:
+        spec = self.program.spec
+        # ranges
+        ranges = [(r.param, _Expr(r.lo_expr), _Expr(r.hi_expr), _Expr(r.step_expr))
+                  for r in tcs.ranges]
+        # order ranges by parameter declaration order
+        order = {p: i for i, p in enumerate(tcs.params)}
+        ranges.sort(key=lambda r: order[r[0]])
+        tc._ptg_ranges = ranges
+        tc._ptg_spec = tcs
+        if tcs.priority_expr:
+            prio = _Expr(tcs.priority_expr)
+            tc.properties["priority"] = lambda loc, _p=prio: int(_p(self._env(loc)))
+        if tcs.affinity is not None:
+            aff_name = tcs.affinity.name
+            aff_exprs = [_Expr(e) for e in tcs.affinity.index_exprs]
+            def affinity_rank(loc, _n=aff_name, _e=aff_exprs):
+                dc = self.collections.get(_n)
+                if dc is None:
+                    return 0
+                env = self._env(loc)
+                return dc.rank_of(*[ex(env) for ex in _e])
+            tc._ptg_rank_of = affinity_rank
+        else:
+            tc._ptg_rank_of = lambda loc: 0
+
+        # in-deps: per flow, ordered guarded alternatives
+        in_specs: List[List[Tuple]] = []
+        for fs in tcs.flows:
+            alts = []
+            for d in fs.deps:
+                if d.direction != "in":
+                    continue
+                guard = _Expr(d.guard) if d.guard else None
+                alts.append((guard, self._mk_ep(d.endpoint)))
+                if d.else_endpoint is not None:
+                    alts.append(("else", self._mk_ep(d.else_endpoint)))
+            in_specs.append(alts)
+        tc._ptg_in_specs = in_specs
+
+        def active_in(alts: List[Tuple], env: Dict[str, Any]):
+            taken = False
+            for guard, ep in alts:
+                if guard is None:
+                    return ep
+                if guard == "else":
+                    if not taken:
+                        return ep
+                    continue
+                taken = bool(guard(env))
+                if taken:
+                    return ep
+            return None
+
+        def goal_fn(loc: Dict[str, int]) -> int:
+            env = self._env(loc)
+            goal = 0
+            for alts in in_specs:
+                ep = active_in(alts, env)
+                if ep is not None and ep["kind"] == "task":
+                    n = 1
+                    for ex in ep["exprs"]:
+                        if ex.is_range:
+                            n *= len(ex.values(env))
+                    goal += n
+            return goal
+
+        tc.dependencies_goal_fn = goal_fn
+        tc._ptg_active_in = active_in
+        for fs, alts in zip(tcs.flows, in_specs):
+            if fs.access == P.FLOW_CTL:
+                continue
+            for _guard, ep in alts:
+                if ep and ep["kind"] == "task" and \
+                        any(ex.is_range for ex in ep["exprs"]):
+                    raise P.PTGSyntaxError(
+                        f"{tcs.name}.{fs.name}: range gather is only valid "
+                        f"on CTL flows (a data flow has exactly one input)")
+
+        # out-deps -> generic-engine Dep descriptors
+        for fi, fs in enumerate(tcs.flows):
+            flow = tc.flows[fi]
+            for d in fs.deps:
+                if d.direction != "out":
+                    continue
+                self._add_out_dep(tc, flow, d.guard, d.endpoint)
+                if d.else_endpoint is not None:
+                    self._add_out_dep(tc, flow, d.guard, d.else_endpoint,
+                                      negate=True)
+
+        # hooks
+        tc.prepare_input = self._mk_prepare_input(tc)
+        tc.complete_execution = self._mk_complete(tc)
+        nb_bodies = 0
+        for body in tcs.bodies:
+            fn = self._compile_body(tcs, body)
+            if body.device == "TPU":
+                tc.add_chore(Chore(DEV_TPU, make_tpu_hook(
+                    self._mk_tpu_submit(tc, fn))))
+                # TPU bodies also serve as host chores through the same
+                # jitted function (degrades to the CPU backend off-pod)
+                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn)))
+            else:
+                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn)))
+            nb_bodies += 1
+
+    def _mk_ep(self, ep: Optional[P.Endpoint]) -> Optional[Dict[str, Any]]:
+        if ep is None:
+            return None
+        return {
+            "kind": ep.kind,
+            "name": ep.name,
+            "flow": ep.flow,
+            "exprs": [_index_expr(e) for e in ep.index_exprs],
+        }
+
+    def _add_out_dep(self, tc: TaskClass, flow: Flow, guard: Optional[str],
+                     ep: P.Endpoint, negate: bool = False) -> None:
+        gexpr = _Expr(guard) if guard else None
+
+        def cond(loc, _g=gexpr, _n=negate):
+            if _g is None:
+                return True
+            v = bool(_g(self._env(loc)))
+            return (not v) if _n else v
+
+        if ep.kind == "task":
+            peer_tc = self._classes[ep.name]
+            peer_spec = self.program.spec.task_class(ep.name)
+            peer_flow_idx = next(i for i, f in enumerate(peer_spec.flows)
+                                 if f.name == ep.flow)
+            exprs = [_index_expr(e) for e in ep.index_exprs]
+
+            def target_locals(loc, _e=exprs, _params=tuple(peer_spec.params)):
+                env = self._env(loc)
+                import itertools
+                axes = [ex.values(env) for ex in _e]
+                return [dict(zip(_params, combo))
+                        for combo in itertools.product(*axes)]
+
+            flow.deps_out.append(Dep(
+                task_class=peer_tc, flow_index=peer_flow_idx,
+                dep_index=len(flow.deps_out), cond=cond,
+                target_locals=target_locals))
+        elif ep.kind == "memory":
+            exprs = [_Expr(e) for e in ep.index_exprs]
+            flow._ptg_mem_out = getattr(flow, "_ptg_mem_out", [])
+            flow._ptg_mem_out.append((cond, ep.name, exprs))
+        # 'null' endpoints: data is dropped
+
+    # ------------------------------------------------------------------ hooks
+    def _mk_prepare_input(self, tc: TaskClass):
+        def prepare_input(stream, task: Task) -> int:
+            env = self._env(task.locals)
+            for fi, flow in enumerate(tc.flows):
+                alts = tc._ptg_in_specs[fi]
+                ep = tc._ptg_active_in(alts, env)
+                if ep is None:
+                    continue
+                slot = task.data[fi]
+                if ep["kind"] == "memory":
+                    dc = self.collections.get(ep["name"])
+                    if dc is None:
+                        output.fatal(f"unknown collection {ep['name']!r}")
+                    data = dc.data_of(*[ex(env) for ex in ep["exprs"]])
+                    copy = data.newest_copy()
+                    # unattached wrapper: body outputs never mutate the
+                    # collection implicitly (write-back is explicit out-deps)
+                    slot.data_in = DataCopy(None, 0, _payload_of(copy))
+                elif ep["kind"] == "task":
+                    peer = self._classes[ep["name"]]
+                    peer_spec = self.program.spec.task_class(ep["name"])
+                    pkey = tuple(ex.values(env)[0] for ex in ep["exprs"])
+                    repo = self.repos[peer.task_class_id]
+                    entry = repo.lookup_entry(pkey)
+                    if entry is None:
+                        output.fatal(f"{task!r}: missing repo entry "
+                                     f"{ep['name']}{pkey}")
+                    pf_idx = next(i for i, f in enumerate(peer_spec.flows)
+                                  if f.name == ep["flow"])
+                    slot.data_in = entry.data[pf_idx]
+                    slot.source_repo_entry = entry
+                elif ep["kind"] == "new":
+                    slot.data_in = None
+            return HOOK_DONE
+        return prepare_input
+
+    def _body_inputs(self, tc: TaskClass, task: Task) -> List[Any]:
+        vals = [task.locals[p] for p in tc._ptg_spec.params]
+        for fi, flow in enumerate(tc.flows):
+            if flow.access & FLOW_ACCESS_CTL:
+                continue
+            vals.append(_payload_of(task.data[fi].data_in))
+        return vals
+
+    def _store_outputs(self, tc: TaskClass, task: Task, outs) -> None:
+        if outs is None:
+            outs = ()
+        elif not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        oi = 0
+        for fi, flow in enumerate(tc.flows):
+            if flow.access & FLOW_ACCESS_CTL or not (flow.access & FLOW_ACCESS_WRITE):
+                continue
+            if oi < len(outs):
+                task.data[fi].data_out = outs[oi]
+            oi += 1
+
+    def _mk_cpu_hook(self, tc: TaskClass, fn):
+        def hook(stream, task: Task) -> int:
+            outs = fn(*self._body_inputs(tc, task))
+            self._store_outputs(tc, task, outs)
+            return HOOK_DONE
+        return hook
+
+    def _mk_tpu_submit(self, tc: TaskClass, fn):
+        def submit(device, task: Task, inputs: List[Any]):
+            vals = [task.locals[p] for p in tc._ptg_spec.params]
+            for fi, flow in enumerate(tc.flows):
+                if flow.access & FLOW_ACCESS_CTL:
+                    continue
+                vals.append(inputs[fi])
+            return fn(*vals)
+        return submit
+
+    def _mk_complete(self, tc: TaskClass):
+        def complete(stream, task: Task) -> int:
+            env = self._env(task.locals)
+            for fi, flow in enumerate(tc.flows):
+                mem_outs = getattr(flow, "_ptg_mem_out", None)
+                if not mem_outs:
+                    continue
+                slot = task.data[fi]
+                value = slot.data_out if slot.data_out is not None else \
+                    _payload_of(slot.data_in)
+                value = _payload_of(value)
+                for cond, dc_name, exprs in mem_outs:
+                    if not cond(task.locals):
+                        continue
+                    dc = self.collections.get(dc_name)
+                    data = dc.data_of(*[ex(env) for ex in exprs])
+                    host = data.get_copy(0)
+                    if host is None:
+                        data.create_copy(0, value, COHERENCY_OWNED)
+                    else:
+                        host.payload = value
+                    data.bump_version(0)
+            return HOOK_DONE
+        return complete
+
+    def _compile_body(self, tcs: P.TaskClassSpec, body: P.BodySpec):
+        """Body text → jitted function(params..., flows...) -> written flows."""
+        data_flows = [f.name for f in tcs.flows if f.access != P.FLOW_CTL]
+        written = [f.name for f in tcs.flows
+                   if f.access in (P.FLOW_WRITE, P.FLOW_RW)]
+        args = list(tcs.params) + data_flows
+        for name in args:
+            if not name.isidentifier():
+                raise P.PTGSyntaxError(f"bad identifier {name!r}")
+        src = textwrap.dedent(body.source)
+        import re as _re
+        if _re.search(r"\breturn\b", src):
+            raise P.PTGSyntaxError(
+                f"BODY of {tcs.name} must not use 'return'; written flows "
+                f"are returned automatically", body.line_no)
+        fn_src = (f"def __ptg_body__({', '.join(args)}):\n"
+                  + textwrap.indent(src if src.strip() else "pass", "    ")
+                  + f"\n    return ({', '.join(written)}{',' if written else ''})")
+        ns: Dict[str, Any] = {}
+        ns.update(self.env_base)
+        try:
+            import jax
+            import jax.numpy as jnp
+            ns.setdefault("jnp", jnp)
+            ns.setdefault("jax", jax)
+            ns.setdefault("lax", jax.lax)
+        except Exception:
+            pass
+        ns.setdefault("np", np)
+        try:
+            exec(compile(fn_src, f"<ptg-body:{tcs.name}>", "exec"), ns)  # noqa: S102
+        except SyntaxError as e:
+            raise P.PTGSyntaxError(
+                f"BODY of {tcs.name} does not compile: {e}", body.line_no) from e
+        raw = ns["__ptg_body__"]
+        import jax
+        return jax.jit(raw)
+
+    # ------------------------------------------------------------------ startup
+    def _enumerate(self):
+        """Yield every locals assignment in the task space, class by class
+        (the generated startup-task enumerator, jdf2c.c:3047)."""
+        for tcs in self.program.spec.task_classes:
+            tc = self._classes[tcs.name]
+            yield from ((tc, loc) for loc in self._enum_class(tc))
+
+    def _enum_class(self, tc: TaskClass):
+        ranges = tc._ptg_ranges
+        def rec(i: int, loc: Dict[str, int]):
+            if i == len(ranges):
+                yield dict(loc)
+                return
+            param, lo, hi, step = ranges[i]
+            env = self._env(loc)
+            lo_v, hi_v, st_v = int(lo(env)), int(hi(env)), int(step(env))
+            for v in range(lo_v, hi_v + 1, st_v):   # inclusive, like JDF
+                loc[param] = v
+                yield from rec(i + 1, loc)
+            loc.pop(param, None)
+        yield from rec(0, {})
+
+    def _startup(self, stream, tp) -> List[Task]:
+        total = 0
+        ready: List[Task] = []
+        my_rank = self.ctx.my_rank
+        distributed = self.ctx.nb_ranks > 1 and self.ctx.comm is not None
+        for tc, loc in self._enumerate():
+            if distributed and tc._ptg_rank_of(loc) != my_rank:
+                continue
+            total += 1
+            if tc.dependencies_goal_fn(loc) == 0:
+                ready.append(self.ctx.make_task(self, tc, loc))
+        self.set_nb_tasks(total)
+        output.debug_verbose(2, "ptg",
+                             f"{self.name}: {total} tasks, {len(ready)} at startup")
+        return ready
+
+
+class PTGProgram:
+    """A compiled PTG program; instantiate per (globals, collections) run."""
+
+    def __init__(self, spec: P.ProgramSpec) -> None:
+        self.spec = spec
+
+    def instantiate(self, ctx: Context, globals: Optional[Dict[str, Any]] = None,
+                    collections: Optional[Dict[str, Any]] = None,
+                    name: Optional[str] = None) -> PTGTaskpool:
+        return PTGTaskpool(self, ctx, dict(globals or {}),
+                           dict(collections or {}), name)
+
+
+def compile_ptg(source: str, name: str = "ptg") -> PTGProgram:
+    """Compile PTG source (the parsec-ptgpp entry point)."""
+    return PTGProgram(P.parse(source, name))

@@ -1,0 +1,21 @@
+"""Every tutorial example runs green (the reference treats examples as
+integration tests in its ctest suite)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = [f"ex0{i}" for i in range(8)]
+EX_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "examples")
+
+
+@pytest.mark.parametrize("ex", EXAMPLES)
+def test_example_runs(ex):
+    fname = [f for f in os.listdir(EX_DIR) if f.startswith(ex)][0]
+    env = dict(os.environ, EXAMPLES_CPU="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, fname], cwd=EX_DIR, env=env,
+                         capture_output=True, text=True, timeout=110)
+    assert out.returncode == 0, out.stderr[-2000:]
