@@ -246,6 +246,8 @@ class PTGTaskpool(Taskpool):
         nb_bodies = 0
         for body in tcs.bodies:
             fn = self._compile_body(tcs, body)
+            if nb_bodies == 0:
+                tc._ptg_body_fn = fn    # cross-DSL replay (pins ptg_to_dtd)
             if body.device == "TPU":
                 tc.add_chore(Chore(DEV_TPU, make_tpu_hook(
                     self._mk_tpu_submit(tc, fn))))

@@ -1,0 +1,159 @@
+"""Trace analysis pipeline: PBP binary traces → tables / Chrome trace.
+
+Re-design of the reference's profiling toolchain (tools/profiling):
+``dbpreader`` + the Cython PBT→PTT pandas pipeline (pbt2ptt.pyx,
+parsec_trace_tables.py) and the Chrome-trace converter (h5toctf.py):
+
+* :func:`read_pbp` — parse the binary trace into dictionary + event records.
+* :func:`to_dataframe` — pandas "trace tables": one row per matched
+  begin/end interval with stream, taskpool, duration, unpacked info fields.
+* :func:`to_chrome_trace` — chrome://tracing / Perfetto JSON.
+* CLI: ``python -m parsec_tpu.tools.trace_reader trace.pbp [--ctf out.json]``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.trace import MAGIC, parse_info_desc
+
+
+@dataclass
+class TraceData:
+    t0: float
+    dictionary: List[Dict[str, Any]]
+    streams: List[Dict[str, Any]]   # {name, events: [(key,eid,tp,t,flags,info)]}
+
+
+def read_pbp(path: str) -> TraceData:
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:8] != MAGIC:
+        raise ValueError(f"{path}: not a PBP trace (magic {raw[:8]!r})")
+    off = 8
+    t0, ndict, nstreams = struct.unpack_from("<dII", raw, off)
+    off += struct.calcsize("<dII")
+
+    def read_str() -> str:
+        nonlocal off
+        (n,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        s = raw[off:off + n].decode()
+        off += n
+        return s
+
+    dictionary = []
+    for key in range(ndict):
+        name, attr, info_desc = read_str(), read_str(), read_str()
+        fields, fmt = parse_info_desc(info_desc)
+        dictionary.append({"key": key, "name": name, "attr": attr,
+                           "info_desc": info_desc, "fields": fields,
+                           "fmt": fmt})
+    streams = []
+    for _ in range(nstreams):
+        name = read_str()
+        (nev,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        events = []
+        for _ in range(nev):
+            key, eid, tpid, t, flags, ilen = struct.unpack_from("<IqIdII", raw, off)
+            off += struct.calcsize("<IqIdII")
+            info = raw[off:off + ilen]
+            off += ilen
+            events.append((key, eid, tpid, t, flags, info))
+        streams.append({"name": name, "events": events})
+    return TraceData(t0, dictionary, streams)
+
+
+def _intervals(trace: TraceData):
+    """Match begin/end pairs per (stream, base key, event id)."""
+    for si, stream in enumerate(trace.streams):
+        open_ev: Dict[Tuple[int, int], Tuple[float, bytes, int]] = {}
+        for key, eid, tpid, t, flags, info in stream["events"]:
+            base, is_end = key >> 1, key & 1
+            if not is_end:
+                open_ev[(base, eid)] = (t, info, tpid)
+            else:
+                start = open_ev.pop((base, eid), None)
+                if start is None:
+                    continue
+                t_s, info_s, tpid_s = start
+                yield si, stream["name"], base, eid, tpid_s, t_s, t, info_s
+
+
+def to_dataframe(trace: TraceData):
+    """The PTT role: one pandas row per begin/end interval."""
+    import pandas as pd
+    rows = []
+    for si, sname, base, eid, tpid, t_s, t_e, info in _intervals(trace):
+        d = trace.dictionary[base]
+        row = {
+            "stream": sname,
+            "stream_id": si,
+            "name": d["name"],
+            "event_id": eid,
+            "taskpool_id": tpid,
+            "begin": t_s - trace.t0,
+            "end": t_e - trace.t0,
+            "duration": t_e - t_s,
+        }
+        if d["fields"] and info:
+            vals = struct.unpack(d["fmt"], info)
+            row.update({fname: v for (fname, _), v in zip(d["fields"], vals)})
+        rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def to_chrome_trace(trace: TraceData) -> Dict[str, Any]:
+    """Chrome trace-event JSON (the h5toctf.py role): load into Perfetto."""
+    events = []
+    for si, sname, base, eid, tpid, t_s, t_e, info in _intervals(trace):
+        d = trace.dictionary[base]
+        events.append({
+            "name": d["name"],
+            "cat": f"taskpool{tpid}",
+            "ph": "X",
+            "ts": (t_s - trace.t0) * 1e6,
+            "dur": (t_e - t_s) * 1e6,
+            "pid": 0,
+            "tid": si,
+            "args": {"event_id": eid},
+        })
+    meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": si,
+             "args": {"name": s["name"]}}
+            for si, s in enumerate(trace.streams)]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: trace_reader <trace.pbp> [--ctf out.json] [--csv out.csv]",
+              file=sys.stderr)
+        return 2
+    trace = read_pbp(argv[0])
+    print(f"trace: {len(trace.dictionary)} keywords, "
+          f"{len(trace.streams)} streams, "
+          f"{sum(len(s['events']) for s in trace.streams)} events")
+    if "--ctf" in argv:
+        out = argv[argv.index("--ctf") + 1]
+        with open(out, "w") as f:
+            json.dump(to_chrome_trace(trace), f)
+        print(f"chrome trace -> {out}")
+    if "--csv" in argv:
+        out = argv[argv.index("--csv") + 1]
+        to_dataframe(trace).to_csv(out, index=False)
+        print(f"trace tables -> {out}")
+    if "--ctf" not in argv and "--csv" not in argv:
+        df = to_dataframe(trace)
+        if len(df):
+            print(df.groupby("name")["duration"].describe())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
