@@ -1,0 +1,115 @@
+"""Virtual-process map and thread binding.
+
+Re-design of parsec/vpmap.c + parsec/bindthread.c + the hwloc wrapper
+(parsec/parsec_hwloc.c): group worker streams into *virtual processes*
+(NUMA-domain-like groups that schedulers steal within first) and bind
+threads to cores. Topology discovery uses os.sched_getaffinity; binding uses
+os.sched_setaffinity where the platform provides it.
+
+Spec grammar (``--mca runtime_vpmap``), following the reference's modes:
+
+* ``flat``           — one VP with all threads (default)
+* ``rr``             — one VP per core, round-robin
+* ``nb:<n>:<t>``     — n VPs with t threads each
+* ``file:<path>``    — one line per VP: comma-separated core ids
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..utils import mca, output
+
+mca.register("runtime_vpmap", "flat", "VP map spec (flat|rr|nb:<n>:<t>|file:<path>)")
+mca.register("runtime_bind_threads", False, "Bind worker threads to cores", type=bool)
+
+
+def available_cores() -> List[int]:
+    try:
+        return sorted(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        return list(range(os.cpu_count() or 1))
+
+
+@dataclass
+class VP:
+    vp_id: int
+    cores: List[int] = field(default_factory=list)
+
+    @property
+    def nb_threads(self) -> int:
+        return len(self.cores)
+
+
+class VPMap:
+    """Ref: parsec_vpmap_init (vpmap.c)."""
+
+    def __init__(self, spec: Optional[str] = None,
+                 nb_threads: Optional[int] = None) -> None:
+        spec = spec or mca.get("runtime_vpmap", "flat")
+        cores = available_cores()
+        if nb_threads:
+            cores = (cores * ((nb_threads + len(cores) - 1) // len(cores)))[:nb_threads]
+        self.vps: List[VP] = []
+        if spec == "flat":
+            self.vps = [VP(0, list(cores))]
+        elif spec == "rr":
+            self.vps = [VP(i, [c]) for i, c in enumerate(cores)]
+        elif spec.startswith("nb:"):
+            try:
+                _, n, t = spec.split(":")
+                n, t = int(n), int(t)
+            except ValueError:
+                output.fatal(f"bad vpmap spec {spec!r}")
+            it = iter(cores * (1 + (n * t) // max(len(cores), 1)))
+            self.vps = [VP(i, [next(it) for _ in range(t)]) for i in range(n)]
+        elif spec.startswith("file:"):
+            path = spec[5:]
+            with open(path) as f:
+                for i, line in enumerate(f):
+                    line = line.split("#", 1)[0].strip()
+                    if not line:
+                        continue
+                    self.vps.append(VP(len(self.vps),
+                                       [int(x) for x in line.split(",")]))
+        else:
+            output.fatal(f"unknown vpmap spec {spec!r}")
+        if not self.vps:
+            self.vps = [VP(0, list(cores))]
+
+    @property
+    def nb_vps(self) -> int:
+        return len(self.vps)
+
+    @property
+    def nb_threads(self) -> int:
+        return sum(vp.nb_threads for vp in self.vps)
+
+    def thread_to_vp(self, th_id: int) -> int:
+        """Map a global thread id to its VP."""
+        i = 0
+        for vp in self.vps:
+            if th_id < i + vp.nb_threads:
+                return vp.vp_id
+            i += vp.nb_threads
+        return self.vps[-1].vp_id
+
+    def core_of(self, th_id: int) -> int:
+        i = 0
+        for vp in self.vps:
+            if th_id < i + vp.nb_threads:
+                return vp.cores[th_id - i]
+            i += vp.nb_threads
+        return self.vps[-1].cores[-1]
+
+
+def bind_current_thread(core: int) -> bool:
+    """parsec_bindthread: pin the calling thread (best effort)."""
+    try:
+        os.sched_setaffinity(0, {core})
+        return True
+    except (AttributeError, OSError) as e:
+        output.debug_verbose(2, "bindthread", f"binding to core {core} failed: {e}")
+        return False

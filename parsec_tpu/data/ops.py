@@ -1,0 +1,107 @@
+"""Generic per-tile helper algorithms over collections.
+
+Re-design of the reference's helper taskpools in parsec/data_dist/matrix
+(apply.jdf + wrapper, reduce.jdf / reduce_col.jdf / reduce_row.jdf,
+broadcast.jdf, map_operator.c): each builds a small task DAG through the DTD
+frontend against any tiled collection. All operators are functional
+(tile -> new tile), so they jit and run on the TPU chore path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
+from .matrix import TiledMatrix
+
+
+def apply(tp: DTDTaskpool, A: TiledMatrix,
+          op: Callable[[int, int, Any], Any], uplo: str = "full") -> int:
+    """Apply ``op(m, n, tile) -> tile`` to every tile (ref: apply.jdf).
+
+    ``uplo`` restricts to 'lower'/'upper' triangles like the reference.
+    """
+    n0 = tp.inserted
+    for m in range(A.mt):
+        for n in range(A.nt):
+            if uplo == "lower" and n > m:
+                continue
+            if uplo == "upper" and n < m:
+                continue
+            tp.insert_task(lambda x, _m, _n: op(int(_m), int(_n), x),
+                           (tp.tile_of(A, m, n), RW | AFFINITY), m, n,
+                           name="apply", jit=False)
+    return tp.inserted - n0
+
+
+def map_operator(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,
+                 op: Callable[[Any, Any], Any]) -> int:
+    """dst tile = op(src tile, dst tile) over two collections
+    (ref: map_operator.c)."""
+    n0 = tp.inserted
+    for m in range(A.mt):
+        for n in range(A.nt):
+            tp.insert_task(op, (tp.tile_of(A, m, n), READ),
+                           (tp.tile_of(B, m, n), RW | AFFINITY),
+                           name="map2")
+    return tp.inserted - n0
+
+
+def reduce_all(tp: DTDTaskpool, A: TiledMatrix,
+               op: Callable[[Any, Any], Any],
+               root: tuple = (0, 0)) -> int:
+    """Binary-tree reduction of every tile into tile ``root``
+    (ref: reduce.jdf). Returns task count; result lands in A[root]."""
+    tiles = [(m, n) for m in range(A.mt) for n in range(A.nt)]
+    tiles.remove(root)
+    tiles.insert(0, root)
+    n0 = tp.inserted
+    stride = 1
+    while stride < len(tiles):
+        for i in range(0, len(tiles) - stride, 2 * stride):
+            dst, src = tiles[i], tiles[i + stride]
+            tp.insert_task(op, (tp.tile_of(A, *dst), RW | AFFINITY),
+                           (tp.tile_of(A, *src), READ), name="reduce")
+        stride *= 2
+    return tp.inserted - n0
+
+
+def reduce_row(tp: DTDTaskpool, A: TiledMatrix,
+               op: Callable[[Any, Any], Any]) -> int:
+    """Reduce each row of tiles into column 0 (ref: reduce_row.jdf)."""
+    n0 = tp.inserted
+    for m in range(A.mt):
+        for n in range(1, A.nt):
+            tp.insert_task(op, (tp.tile_of(A, m, 0), RW | AFFINITY),
+                           (tp.tile_of(A, m, n), READ), name="reduce_row")
+    return tp.inserted - n0
+
+
+def reduce_col(tp: DTDTaskpool, A: TiledMatrix,
+               op: Callable[[Any, Any], Any]) -> int:
+    """Reduce each column of tiles into row 0 (ref: reduce_col.jdf)."""
+    n0 = tp.inserted
+    for n in range(A.nt):
+        for m in range(1, A.mt):
+            tp.insert_task(op, (tp.tile_of(A, 0, n), RW | AFFINITY),
+                           (tp.tile_of(A, m, n), READ), name="reduce_col")
+    return tp.inserted - n0
+
+
+def broadcast(tp: DTDTaskpool, A: TiledMatrix, root: tuple = (0, 0)) -> int:
+    """Copy tile ``root`` into every tile of A (ref: broadcast.jdf).
+
+    In distributed mode the copies to remote owners ride the runtime's
+    multicast trees automatically (one writer, many remote readers)."""
+    n0 = tp.inserted
+    src = tp.tile_of(A, *root)
+    for m in range(A.mt):
+        for n in range(A.nt):
+            if (m, n) == root:
+                continue
+            tp.insert_task(lambda dst, s: s,
+                           (tp.tile_of(A, m, n), RW | AFFINITY), (src, READ),
+                           name="bcast")
+    return tp.inserted - n0

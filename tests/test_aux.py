@@ -1,0 +1,238 @@
+"""Auxiliary subsystem tests: compound, futures, vpmap, zone malloc,
+counters, collection ops, redistribution, reshape promises.
+
+Covers the reference's tests/api/compose.c, tests/class/future*.c,
+tests/collections/{reshape,redistribute,reduce} shapes.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.compound import compose
+from parsec_tpu.core.context import Context
+from parsec_tpu.core.futures import CountdownFuture, DataCopyFuture, Future
+from parsec_tpu.core.task import HOOK_DONE, Task, TaskClass, Taskpool, Flow, FLOW_ACCESS_CTL, Chore, DEV_CPU
+from parsec_tpu.core.vpmap import VPMap, available_cores
+from parsec_tpu.data.data import DataCopy, data_from_array
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.data.ops import apply, broadcast, map_operator, reduce_all, reduce_col, reduce_row
+from parsec_tpu.data.redistribute import redistribute
+from parsec_tpu.data.reshape import ReshapeCache, ReshapeSpec, needs_reshape
+from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+from parsec_tpu.utils.counters import CounterRegistry, install_scheduler_counters
+from parsec_tpu.utils.zone_malloc import ZoneMalloc
+
+
+@pytest.fixture()
+def ctx():
+    c = Context(nb_cores=1)
+    yield c
+    c.fini()
+
+
+def _simple_pool(name, log):
+    tp = Taskpool(name)
+    tc = TaskClass(f"T{name}")
+    tc.add_flow(Flow("ctl", FLOW_ACCESS_CTL))
+    tc.count_mode = True
+
+    def body(stream, task):
+        log.append(name)
+        return HOOK_DONE
+
+    tc.add_chore(Chore(DEV_CPU, body))
+    tp.add_task_class(tc)
+
+    def startup(stream, pool):
+        pool.set_nb_tasks(2)
+        return [Task(pool, tc, {"i": i}) for i in range(2)]
+
+    tp.startup_hook = startup
+    return tp
+
+
+def test_compose_sequential(ctx):
+    """Stages run strictly one after another (ref: tests/api/compose.c)."""
+    log = []
+    comp = compose(ctx, _simple_pool("a", log), _simple_pool("b", log),
+                   _simple_pool("c", log))
+    ctx.wait()
+    assert comp.completed
+    assert log == ["a", "a", "b", "b", "c", "c"]
+
+
+def test_compose_lazy_stage(ctx):
+    log = []
+    comp = compose(ctx, _simple_pool("x", log))
+    comp.add(lambda: _simple_pool("y", log))
+    ctx.wait()
+    assert log[:2] == ["x", "x"] and log[2:] == ["y", "y"]
+
+
+def test_future_basic():
+    f = Future()
+    got = []
+    f.on_ready(got.append)
+    f.set(42)
+    assert f.get() == 42 and got == [42]
+    with pytest.raises(RuntimeError):
+        f.set(1)
+    late = []
+    f.on_ready(late.append)
+    assert late == [42]
+
+
+def test_countdown_future():
+    f = CountdownFuture(3, combine=lambda a, b: a + b)
+    f.contribute(1)
+    f.contribute(2)
+    assert not f.ready
+    f.contribute(3)
+    assert f.get() == 6
+
+
+def test_datacopy_future_triggers_once():
+    calls = []
+
+    def trig(src, spec):
+        calls.append(1)
+        return DataCopy(None, 0, np.asarray(src.payload) * 2)
+
+    src = DataCopy(None, 0, np.ones((2, 2), np.float32))
+    f = DataCopyFuture(src, None, trig)
+    a = f.request()
+    b = f.request()
+    assert a is b and len(calls) == 1
+    assert np.allclose(a.payload, 2.0)
+
+
+def test_vpmap_modes(tmp_path):
+    flat = VPMap("flat")
+    assert flat.nb_vps == 1 and flat.nb_threads == len(available_cores())
+    rr = VPMap("rr")
+    assert rr.nb_vps == len(available_cores())
+    nb = VPMap("nb:2:3")
+    assert nb.nb_vps == 2 and nb.nb_threads == 6
+    assert nb.thread_to_vp(0) == 0 and nb.thread_to_vp(5) == 1
+    p = tmp_path / "vp.map"
+    p.write_text("0\n0,0  # two threads on core 0\n")
+    fm = VPMap(f"file:{p}")
+    assert fm.nb_vps == 2 and fm.vps[1].nb_threads == 2
+
+
+def test_zone_malloc_first_fit_and_coalesce():
+    z = ZoneMalloc(16 << 20, unit=1 << 20)  # 16 units
+    a = z.allocate(4 << 20)
+    b = z.allocate(4 << 20)
+    c = z.allocate(8 << 20)
+    assert z.allocate(1) is None          # full
+    b.free()
+    assert z.stats()["holes"] == 1
+    d = z.allocate(2 << 20)               # first fit into b's hole
+    assert d.offset == b.offset
+    a.free(); c.free(); d.free()
+    st = z.stats()
+    assert st["holes"] == 1 and st["free_bytes"] == 16 << 20
+    assert st["hwm_bytes"] == 16 << 20
+
+
+def test_counters(ctx):
+    install_scheduler_counters(ctx)
+    from parsec_tpu.utils import counters as C
+    before = C.counters.read(C.TASKS_RETIRED)
+    tp = DTDTaskpool(ctx, "cnt")
+    t = tp.tile_new((2, 2), np.float32)
+    for _ in range(5):
+        tp.insert_task(lambda x: x + 1.0, (t, RW))
+    tp.wait(); tp.close(); ctx.wait()
+    assert C.counters.read(C.TASKS_RETIRED) - before == 5
+    assert C.counters.read(C.PENDING_TASKS) == C.counters.read(C.TASKS_ENABLED) - C.counters.read(C.TASKS_RETIRED)
+
+
+def test_collection_ops(ctx):
+    A = TiledMatrix("A", 16, 16, 4, 4)
+    A.fill(lambda m, n: np.full((4, 4), float(m * 4 + n), np.float32))
+    tp = DTDTaskpool(ctx, "ops")
+    apply(tp, A, lambda m, n, x: x + 1.0)
+    reduce_all(tp, A, lambda d, s: d + s)
+    tp.wait(); tp.close(); ctx.wait()
+    # after apply: tile (m,n) = m*4+n+1; reduce_all sums all 16 into (0,0)
+    expect = sum(m * 4 + n + 1 for m in range(4) for n in range(4))
+    assert np.allclose(np.asarray(A.data_of(0, 0).newest_copy().payload), expect)
+
+
+def test_reduce_row_col_and_broadcast(ctx):
+    A = TiledMatrix("A", 8, 8, 4, 4)
+    A.fill(lambda m, n: np.full((4, 4), float(10 * m + n), np.float32))
+    tp = DTDTaskpool(ctx, "rr")
+    reduce_row(tp, A, lambda d, s: d + s)   # col0: 10m + (0+1)
+    reduce_col(tp, A, lambda d, s: d + s)   # (0,0): (0+1) + (10+11)
+    tp.wait(); tp.close(); ctx.wait()
+    assert np.allclose(np.asarray(A.data_of(0, 0).newest_copy().payload), 22.0)
+    tp2 = DTDTaskpool(ctx, "bc")
+    broadcast(tp2, A, root=(0, 0))
+    tp2.wait(); tp2.close(); ctx.wait()
+    for m in range(2):
+        for n in range(2):
+            assert np.allclose(np.asarray(A.data_of(m, n).newest_copy().payload), 22.0)
+
+
+def test_map_operator(ctx):
+    A = TiledMatrix("A", 8, 8, 4, 4)
+    B = TiledMatrix("B", 8, 8, 4, 4)
+    A.fill(lambda m, n: np.full((4, 4), 3.0, np.float32))
+    B.fill(lambda m, n: np.full((4, 4), 4.0, np.float32))
+    tp = DTDTaskpool(ctx, "map2")
+    map_operator(tp, A, B, lambda a, b: a * b)
+    tp.wait(); tp.close(); ctx.wait()
+    assert np.allclose(B.to_dense(), 12.0)
+
+
+def test_redistribute_aligned(ctx):
+    S = TiledMatrix("S", 32, 32, 8, 8)
+    T = TiledMatrix("T", 32, 32, 16, 16)   # different tile size
+    rng = np.random.default_rng(13)
+    dense = rng.standard_normal((32, 32)).astype(np.float32)
+    S.fill(lambda m, n: dense[m*8:(m+1)*8, n*8:(n+1)*8])
+    T.fill(lambda m, n: np.zeros((16, 16), np.float32))
+    tp = DTDTaskpool(ctx, "redist")
+    redistribute(tp, S, T)
+    tp.wait(); tp.close(); ctx.wait()
+    np.testing.assert_allclose(T.to_dense(), dense)
+
+
+def test_redistribute_unaligned_offsets(ctx):
+    """Non-aligned offsets on both sides (ref: redistribute random tests)."""
+    S = TiledMatrix("S", 24, 24, 8, 8)
+    T = TiledMatrix("T", 24, 24, 5, 5)     # deliberately awkward tiles
+    rng = np.random.default_rng(14)
+    dense = rng.standard_normal((24, 24)).astype(np.float32)
+    S.fill(lambda m, n: dense[m*8:(m+1)*8, n*8:(n+1)*8])
+    T.fill(lambda m, n: np.zeros(T.tile_shape(m, n), np.float32))
+    tp = DTDTaskpool(ctx, "redist2")
+    m, n, si, sj, ti, tj = 13, 11, 3, 5, 7, 2
+    redistribute(tp, S, T, m, n, si, sj, ti, tj)
+    tp.wait(); tp.close(); ctx.wait()
+    got = T.to_dense()
+    np.testing.assert_allclose(got[ti:ti+m, tj:tj+n],
+                               dense[si:si+m, sj:sj+n])
+    # everything outside the window untouched
+    mask = np.ones((24, 24), bool)
+    mask[ti:ti+m, tj:tj+n] = False
+    assert np.allclose(got[mask], 0.0)
+
+
+def test_reshape_promise_shared():
+    cache = ReshapeCache()
+    d = data_from_array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    copy = d.get_copy(0)
+    spec = ReshapeSpec(dtype="float64", transpose=True)
+    assert needs_reshape(copy, spec)
+    r1 = cache.get_reshaped(copy, spec)
+    r2 = cache.get_reshaped(copy, spec)
+    assert r1 is r2
+    assert r1.payload.shape == (4, 3) and str(r1.payload.dtype) == "float64"
+    noop = ReshapeSpec()
+    assert cache.get_reshaped(copy, noop) is copy
