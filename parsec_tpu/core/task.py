@@ -230,8 +230,8 @@ class Taskpool:
         self._nb_tasks = 0
         self._nb_pending_actions = 0
         self._completed_event = threading.Event()
-        # dependency-tracking state: task_class_id -> {key -> satisfied mask/count}
-        self._deps: List[Dict[Any, int]] = []
+        # dependency-tracking state: task_class_id -> table (dict or native)
+        self._deps: List[Any] = []
         self._deps_locks: List[threading.Lock] = []
         # per-task-class data repos, installed by the DSL
         self.repos: List[Any] = []
@@ -240,7 +240,7 @@ class Taskpool:
     def add_task_class(self, tc: TaskClass) -> TaskClass:
         tc.task_class_id = len(self.task_classes)
         self.task_classes.append(tc)
-        self._deps.append({})
+        self._deps.append(None)   # backend chosen on first update_deps
         self._deps_locks.append(threading.Lock())
         self.repos.append(None)
         return tc
@@ -310,6 +310,11 @@ class Taskpool:
         if goal is None:
             goal = tc.dependencies_goal
         table = self._deps[tc.task_class_id]
+        if table is None:
+            table = self._pick_dep_backend(tc, key)
+        if not isinstance(table, dict):
+            # native C++ dependency engine (see parsec_tpu/native.py)
+            return table.update(key, contribution, goal, tc.count_mode)
         with self._deps_locks[tc.task_class_id]:
             cur = table.get(key, 0)
             if tc.count_mode:
@@ -323,5 +328,27 @@ class Taskpool:
             table[key] = cur
             return False
 
+    def _pick_dep_backend(self, tc: TaskClass, key: Any):
+        """Choose dict vs the native C++ table on first use, by key shape
+        (native path handles int-tuple keys, the DSL-generated common case)."""
+        with self._deps_locks[tc.task_class_id]:
+            table = self._deps[tc.task_class_id]
+            if table is not None:
+                return table
+            table: Any = {}
+            try:
+                from ..native import NativeDepTable, available
+                if available() and NativeDepTable.key_ok(key):
+                    table = NativeDepTable()
+            except Exception:  # noqa: BLE001 - fall back to pure Python
+                table = {}
+            self._deps[tc.task_class_id] = table
+            return table
+
     def dep_state(self, tc: TaskClass, key: Any) -> int:
-        return self._deps[tc.task_class_id].get(key, 0)
+        table = self._deps[tc.task_class_id]
+        if table is None:
+            return 0
+        if not isinstance(table, dict):
+            return table.get(key)
+        return table.get(key, 0)

@@ -1,0 +1,219 @@
+"""ctypes bindings for the native C++ core (native/src/ptcore.cpp).
+
+The library is built on demand with the in-tree Makefile; every binding has
+a pure-Python fallback so the framework works without a toolchain. Wired-in
+fast paths:
+
+* :class:`NativeDepTable` — the dependency-update engine
+  (parsec_update_deps_with_mask role) behind ``Taskpool.update_deps`` for
+  integer-tuple keys.
+* :class:`NativeZone` — backend for :class:`parsec_tpu.utils.zone_malloc`.
+* :class:`NativeDeque` — handle deque for scheduler experiments.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+from .utils import mca, output
+
+mca.register("native_enabled", True, "Use the native C++ core when available", type=bool)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_ROOT, "native")
+_SO = os.path.join(_NATIVE_DIR, "build", "libptcore.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_KEY_MAX = 16
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
+                           text=True, timeout=120)
+        if r.returncode != 0:
+            output.debug_verbose(1, "native", f"build failed: {r.stderr[-500:]}")
+            return False
+        return os.path.exists(_SO)
+    except Exception as e:  # noqa: BLE001
+        output.debug_verbose(1, "native", f"build error: {e}")
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on failure."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not mca.get("native_enabled", True):
+        return None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            output.debug_verbose(1, "native", f"dlopen failed: {e}")
+            return None
+        # signatures
+        lib.pt_dep_table_create.restype = ctypes.c_void_p
+        lib.pt_dep_table_create.argtypes = [ctypes.c_uint64]
+        lib.pt_dep_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_dep_table_size.restype = ctypes.c_int64
+        lib.pt_dep_table_size.argtypes = [ctypes.c_void_p]
+        lib.pt_dep_table_update.restype = ctypes.c_int32
+        lib.pt_dep_table_update.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+        lib.pt_dep_table_get.restype = ctypes.c_int64
+        lib.pt_dep_table_get.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32]
+        lib.pt_zone_create.restype = ctypes.c_void_p
+        lib.pt_zone_create.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.pt_zone_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_zone_alloc.restype = ctypes.c_int64
+        lib.pt_zone_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pt_zone_free.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_int64]
+        lib.pt_zone_stats.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_deque_create.restype = ctypes.c_void_p
+        lib.pt_deque_destroy.argtypes = [ctypes.c_void_p]
+        for f in ("pt_deque_push_front", "pt_deque_push_back"):
+            getattr(lib, f).argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        for f in ("pt_deque_pop_front", "pt_deque_pop_back"):
+            getattr(lib, f).restype = ctypes.c_uint64
+            getattr(lib, f).argtypes = [ctypes.c_void_p]
+        lib.pt_deque_size.restype = ctypes.c_int64
+        lib.pt_deque_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        output.debug_verbose(1, "native", f"native core loaded from {_SO}")
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeDepTable:
+    """Dependency tracker for int-tuple keys (mask or counter mode)."""
+
+    __slots__ = ("_t", "_lib")
+
+    def __init__(self, capacity: int = 1 << 16) -> None:
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._t = self._lib.pt_dep_table_create(capacity)
+        if not self._t:
+            raise MemoryError("pt_dep_table_create failed")
+
+    @staticmethod
+    def key_ok(key) -> bool:
+        if isinstance(key, int):
+            return True
+        return (isinstance(key, tuple) and len(key) <= _KEY_MAX
+                and all(isinstance(k, int) for k in key))
+
+    @staticmethod
+    def _pack(key) -> Tuple[ctypes.Array, int]:
+        # fresh array per call: update() is invoked concurrently from worker
+        # threads, a shared buffer would race before the C side copies it
+        if isinstance(key, int):
+            return (ctypes.c_int64 * 1)(key), 1
+        return (ctypes.c_int64 * len(key))(*key), len(key)
+
+    def update(self, key, contribution: int, goal: int, count_mode: bool) -> bool:
+        buf, klen = self._pack(key)
+        rc = self._lib.pt_dep_table_update(self._t, buf, klen, contribution,
+                                           goal, 1 if count_mode else 0)
+        if rc < 0:
+            raise RuntimeError(f"native dep table error {rc}")
+        return rc == 1
+
+    def get(self, key) -> int:
+        buf, klen = self._pack(key)
+        return self._lib.pt_dep_table_get(self._t, buf, klen)
+
+    def __len__(self) -> int:
+        return self._lib.pt_dep_table_size(self._t)
+
+    def __del__(self) -> None:
+        try:
+            if self._t and self._lib:
+                self._lib.pt_dep_table_destroy(self._t)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
+
+
+class NativeZone:
+    """Native zone allocator backend (see utils/zone_malloc.py)."""
+
+    __slots__ = ("_z", "_lib")
+
+    def __init__(self, total_bytes: int, unit: int = 1 << 20) -> None:
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._z = self._lib.pt_zone_create(total_bytes, unit)
+
+    def alloc(self, nbytes: int) -> Optional[int]:
+        off = self._lib.pt_zone_alloc(self._z, nbytes)
+        return None if off < 0 else off
+
+    def free(self, offset: int, nbytes: int) -> None:
+        self._lib.pt_zone_free(self._z, offset, nbytes)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_int64 * 4)()
+        self._lib.pt_zone_stats(self._z, out)
+        return {"free_bytes": out[0], "in_use_bytes": out[1],
+                "hwm_bytes": out[2], "largest_hole_bytes": out[3]}
+
+    def __del__(self) -> None:
+        try:
+            if self._z and self._lib:
+                self._lib.pt_zone_destroy(self._z)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class NativeDeque:
+    """Handle deque (uint64, nonzero handles)."""
+
+    __slots__ = ("_d", "_lib")
+
+    def __init__(self) -> None:
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._d = self._lib.pt_deque_create()
+
+    def push_front(self, h: int) -> None:
+        self._lib.pt_deque_push_front(self._d, h)
+
+    def push_back(self, h: int) -> None:
+        self._lib.pt_deque_push_back(self._d, h)
+
+    def pop_front(self) -> int:
+        return self._lib.pt_deque_pop_front(self._d)
+
+    def pop_back(self) -> int:
+        return self._lib.pt_deque_pop_back(self._d)
+
+    def __len__(self) -> int:
+        return self._lib.pt_deque_size(self._d)
+
+    def __del__(self) -> None:
+        try:
+            if self._d and self._lib:
+                self._lib.pt_deque_destroy(self._d)
+        except Exception:  # noqa: BLE001
+            pass

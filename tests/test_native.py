@@ -1,0 +1,109 @@
+"""Native C++ core tests (dep table, zone, deque) — tests/class analogue."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core unavailable")
+
+
+def test_dep_table_mask_mode():
+    t = native.NativeDepTable(1 << 10)
+    # three dep bits; ready exactly when all three arrive
+    assert not t.update((3, 7), 0b001, 0b111, False)
+    assert not t.update((3, 7), 0b010, 0b111, False)
+    assert t.get((3, 7)) == 0b011
+    assert t.update((3, 7), 0b100, 0b111, False)
+    # entry retired: same key restarts from scratch
+    assert t.get((3, 7)) == 0
+    assert not t.update((3, 7), 0b001, 0b111, False)
+
+
+def test_dep_table_counter_mode_and_single_dep():
+    t = native.NativeDepTable()
+    assert not t.update((1,), 1, 3, True)
+    assert not t.update((1,), 1, 3, True)
+    assert t.update((1,), 1, 3, True)
+    # goal reached on first contribution -> never stored
+    assert t.update((9, 9, 9), 1, 1, True)
+    assert len(t) == 0
+
+
+def test_dep_table_many_keys():
+    t = native.NativeDepTable(1 << 8)  # force probing/growth pressure
+    n = 500
+    for i in range(n):
+        assert not t.update((i, i * 31), 1, 2, True)
+    assert len(t) == n
+    for i in range(n):
+        assert t.update((i, i * 31), 1, 2, True)
+    assert len(t) == 0
+
+
+def test_dep_table_concurrent():
+    t = native.NativeDepTable(1 << 12)
+    ready = []
+    lock = threading.Lock()
+    GOAL = 8
+
+    def worker(wid):
+        local = []
+        for i in range(200):
+            if t.update((i,), 1, GOAL, True):
+                local.append(i)
+        with lock:
+            ready.extend(local)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(GOAL)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # each key becomes ready exactly once
+    assert sorted(ready) == list(range(200))
+
+
+def test_native_zone_matches_python_semantics():
+    z = native.NativeZone(16 << 20, unit=1 << 20)
+    a = z.alloc(4 << 20)
+    b = z.alloc(4 << 20)
+    c = z.alloc(8 << 20)
+    assert z.alloc(1) is None
+    z.free(b, 4 << 20)
+    d = z.alloc(2 << 20)
+    assert d == b
+    z.free(a, 4 << 20); z.free(c, 8 << 20); z.free(d, 2 << 20)
+    st = z.stats()
+    assert st["free_bytes"] == 16 << 20
+    assert st["largest_hole_bytes"] == 16 << 20
+
+
+def test_native_deque():
+    d = native.NativeDeque()
+    for h in (1, 2, 3):
+        d.push_back(h)
+    d.push_front(99)
+    assert len(d) == 4
+    assert d.pop_front() == 99
+    assert d.pop_back() == 3
+    assert d.pop_front() == 1
+    assert d.pop_front() == 2
+    assert d.pop_front() == 0  # empty sentinel
+
+
+def test_taskpool_uses_native_for_int_keys():
+    """PTG-style int-tuple keys ride the native dep engine."""
+    from parsec_tpu.core.task import TaskClass, Taskpool
+    tp = Taskpool("nat")
+    tc = TaskClass("T")
+    tc.count_mode = True
+    tc.make_key = lambda _tp, loc: (loc["k"],)
+    tp.add_task_class(tc)
+    assert not tp.update_deps(tc, (5,), 1, goal=2)
+    assert tp.update_deps(tc, (5,), 1, goal=2)
+    assert not isinstance(tp._deps[tc.task_class_id], dict)
