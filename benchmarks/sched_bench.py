@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Scheduler micro-benchmark: tasks/second on an embarrassingly-parallel DAG.
+
+The reference's scheduler comparison harness (tests/runtime/scheduling:
+ep.jdf + main.c) re-done for this runtime: N independent no-op tasks pushed
+through each scheduler module; reports steady-state tasks/sec (one of the
+driver's primary metrics, BASELINE.json).
+
+Usage: python benchmarks/sched_bench.py [ntasks] [sched,sched,...]
+Prints one JSON object per scheduler.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_one(sched: str, ntasks: int) -> dict:
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.core.task import (Chore, DEV_CPU, Flow, FLOW_ACCESS_CTL,
+                                      HOOK_DONE, Task, TaskClass, Taskpool)
+    ctx = Context(nb_cores=1, scheduler=sched)
+    tp = Taskpool("ep")
+    tc = TaskClass("EP")
+    tc.add_flow(Flow("ctl", FLOW_ACCESS_CTL))
+    tc.count_mode = True
+    tc.add_chore(Chore(DEV_CPU, lambda s, t: HOOK_DONE))
+    tp.add_task_class(tc)
+
+    def startup(stream, pool):
+        pool.set_nb_tasks(ntasks)
+        return [Task(pool, tc, {"i": i}) for i in range(ntasks)]
+
+    tp.startup_hook = startup
+    t0 = time.perf_counter()
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    dt = time.perf_counter() - t0
+    ctx.fini()
+    return {"metric": "scheduler-tasks-per-sec", "sched": sched,
+            "value": round(ntasks / dt, 1), "unit": "tasks/s",
+            "ntasks": ntasks}
+
+
+def main() -> None:
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")  # no device needed
+    except Exception:
+        pass
+    ntasks = int(sys.argv[1]) if len(sys.argv) > 1 else 20000
+    from parsec_tpu.core import scheduler as S
+    scheds = sys.argv[2].split(",") if len(sys.argv) > 2 else S.available()
+    for s in scheds:
+        print(json.dumps(bench_one(s, ntasks)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

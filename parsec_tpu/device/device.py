@@ -30,6 +30,8 @@ mca.register("device_load_balance_skew", 20,
 mca.register("device_load_balance_allow_cpu", True,
              "Allow spilling accelerator-capable tasks to the CPU device", type=bool)
 mca.register("device_tpu_enabled", True, "Enable the TPU device module", type=bool)
+mca.register("device_recursive_enabled", True,
+             "Enable the recursive (nested-taskpool) device", type=bool)
 
 
 class DeviceModule:
@@ -107,6 +109,9 @@ class DeviceRegistry:
     def _discover(self, context) -> None:
         from .cpu import CPUDevice
         self.add(CPUDevice())
+        if mca.get("device_recursive_enabled", True):
+            from .recursive import RecursiveDevice
+            self.add(RecursiveDevice())  # device 1, like the reference
         if mca.get("device_tpu_enabled", True):
             try:
                 from .tpu import discover_tpu_devices

@@ -176,12 +176,13 @@ class DTDTaskpool(Taskpool):
             # distributed: global termination detection + name-keyed registry
             context.comm.fourcounter.monitor_taskpool(self)
             context.comm.register_taskpool(self)
-        context.add_taskpool(self)
-        # hold the "user may still insert" action so local termdet doesn't
-        # fire between insertions (the reference keeps the taskpool's own
-        # nb_pending_actions pinned while attached)
+        # hold the "user may still insert" action BEFORE attaching, so the
+        # termdet can never observe transiently-zero counters at enqueue time
+        # (the reference keeps the taskpool's own nb_pending_actions pinned
+        # while attached)
         self.addto_nb_pending_actions(1)
         self._open = True
+        context.add_taskpool(self)
 
     # ------------------------------------------------------------- tiles
     def tile_of(self, dc: DataCollection, *indices) -> DTDTile:

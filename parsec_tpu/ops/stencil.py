@@ -1,0 +1,123 @@
+"""Stencil kernels and DAG builders (halo exchange over the task graph).
+
+Re-design of the reference's stencil app (tests/apps/stencil: stencil_1D.jdf
+with ghost exchange + CORE kernel): each iteration's tile task reads its two
+neighbors' tiles from the *previous* iteration (the halos) — in distributed
+runs those reads become remote deps and the halo exchange rides the comm
+engine exactly like the JDF version rides MPI. Jacobi-style double buffering
+keeps bodies functional (and jittable).
+
+The compute body is a 3-point (1D) / 5-point (2D) weighted stencil; on TPU
+it lowers to fused vector ops (and is a natural Pallas candidate — see
+ops/pallas_kernels.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..data.matrix import TiledMatrix
+from ..dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
+
+
+def stencil1d_body(x, left, right, w0=0.25, w1=0.5, w2=0.25):
+    """One Jacobi step on a (1, nb) tile row with halo columns from the
+    neighbor tiles (zeros at the domain boundary)."""
+    import jax.numpy as jnp
+    lcol = left[..., -1:] if left is not None else jnp.zeros_like(x[..., :1])
+    rcol = right[..., :1] if right is not None else jnp.zeros_like(x[..., :1])
+    xm = jnp.concatenate([lcol, x[..., :-1]], axis=-1)
+    xp = jnp.concatenate([x[..., 1:], rcol], axis=-1)
+    return w0 * xm + w1 * x + w2 * xp
+
+
+def _mk_body(has_left: bool, has_right: bool, w):
+    w0, w1, w2 = w
+    if has_left and has_right:
+        def body(x, l, r):
+            return stencil1d_body(x, l, r, w0, w1, w2)
+    elif has_left:
+        def body(x, l):
+            return stencil1d_body(x, l, None, w0, w1, w2)
+    elif has_right:
+        def body(x, r):
+            return stencil1d_body(x, None, r, w0, w1, w2)
+    else:
+        def body(x):
+            return stencil1d_body(x, None, None, w0, w1, w2)
+    return body
+
+
+# one body fn per (has_left, has_right) so jit compiles exactly 4 variants
+_BODIES = {}
+
+
+def _body_for(has_left: bool, has_right: bool, w) -> callable:
+    key = (has_left, has_right, w)
+    b = _BODIES.get(key)
+    if b is None:
+        b = _mk_body(has_left, has_right, w)
+        _BODIES[key] = b
+    return b
+
+
+def insert_stencil1d_tasks(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,
+                           iterations: int,
+                           weights=(0.25, 0.5, 0.25)) -> int:
+    """Jacobi 1D stencil over ``iterations`` steps, ping-ponging A <-> B.
+
+    The result lands in A when ``iterations`` is even, else in B. Returns
+    the number of inserted tasks (ref: testing_stencil_1D.c driver).
+    """
+    assert A.nt == B.nt and A.mt == B.mt == 1, "1D stencil: one tile row"
+    n0 = tp.inserted
+    src, dst = A, B
+    for _ in range(iterations):
+        for i in range(src.nt):
+            args = [(tp.tile_of(dst, 0, i), RW | AFFINITY),
+                    (tp.tile_of(src, 0, i), READ)]
+            if i > 0:
+                args.append((tp.tile_of(src, 0, i - 1), READ))
+            if i < src.nt - 1:
+                args.append((tp.tile_of(src, 0, i + 1), READ))
+            body = _body_for(i > 0, i < src.nt - 1, weights)
+            tp.insert_task(_StencilTask(body), *args, name="ST")
+        src, dst = dst, src
+    return tp.inserted - n0
+
+
+class _StencilTask:
+    """Callable wrapper with stable identity per boundary variant so the
+    jit cache and DTD task-class cache both hit."""
+
+    _cache = {}
+
+    def __new__(cls, body):
+        inst = cls._cache.get(body)
+        if inst is None:
+            inst = super().__new__(cls)
+            inst.body = body
+            cls._cache[body] = inst
+        return inst
+
+    def __call__(self, d, x, *halos):
+        return self.body(x, *halos)
+
+
+def stencil_flops(n_points: int, iterations: int) -> float:
+    """FLOPS_STENCIL_1D role (ref: testing_stencil_1D.c:142): 5 flops/point."""
+    return 5.0 * n_points * iterations
+
+
+def reference_stencil1d(dense: np.ndarray, iterations: int,
+                        weights=(0.25, 0.5, 0.25)) -> np.ndarray:
+    """Numpy oracle for tests."""
+    w0, w1, w2 = weights
+    x = dense.astype(np.float64)
+    for _ in range(iterations):
+        xm = np.concatenate([np.zeros_like(x[..., :1]), x[..., :-1]], axis=-1)
+        xp = np.concatenate([x[..., 1:], np.zeros_like(x[..., :1])], axis=-1)
+        x = w0 * xm + w1 * x + w2 * xp
+    return x

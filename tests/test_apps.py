@@ -1,0 +1,166 @@
+"""Application integration tests (the reference's tests/apps suite):
+stencil w/ halo exchange, all2all, merge sort, haar tree, pingpong,
+recursive device."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.apps import all2all, haar_transform, merge_sort, pingpong
+from parsec_tpu.core.context import Context
+from parsec_tpu.core.task import (Chore, DEV_RECURSIVE, Flow, FLOW_ACCESS_CTL,
+                                  Task, TaskClass, Taskpool)
+from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+from parsec_tpu.device.recursive import make_recursive_hook
+from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+from parsec_tpu.ops.stencil import (insert_stencil1d_tasks,
+                                    reference_stencil1d, stencil_flops)
+
+
+@pytest.fixture()
+def ctx():
+    c = Context(nb_cores=1)
+    yield c
+    c.fini()
+
+
+def test_stencil1d(ctx):
+    NT, TS, ITERS = 6, 16, 5
+    rng = np.random.default_rng(20)
+    dense = rng.standard_normal((1, NT * TS)).astype(np.float32)
+    A = TiledMatrix("SA", 1, NT * TS, 1, TS)
+    B = TiledMatrix("SB", 1, NT * TS, 1, TS)
+    A.fill(lambda m, n: dense[:, n*TS:(n+1)*TS])
+    B.fill(lambda m, n: np.zeros((1, TS), np.float32))
+    tp = DTDTaskpool(ctx, "stencil")
+    ntasks = insert_stencil1d_tasks(tp, A, B, ITERS)
+    assert ntasks == NT * ITERS
+    tp.wait(); tp.close(); ctx.wait()
+    out = (B if ITERS % 2 else A).to_dense()
+    ref = reference_stencil1d(dense, ITERS)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    assert stencil_flops(NT * TS, ITERS) == 5 * NT * TS * ITERS
+
+
+def test_stencil1d_distributed():
+    """Halo exchange across ranks: boundary tile reads cross the fabric."""
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+
+    NT, TS, ITERS = 4, 8, 3
+    rng = np.random.default_rng(21)
+    dense = rng.standard_normal((1, NT * TS)).astype(np.float32)
+
+    def program(rank, fabric):
+        c = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(c, ThreadsCE(fabric, rank))
+        A = TwoDimBlockCyclic("SA", 1, NT * TS, 1, TS, P=1, Q=2,
+                              nodes=2, myrank=rank)
+        B = TwoDimBlockCyclic("SB", 1, NT * TS, 1, TS, P=1, Q=2,
+                              nodes=2, myrank=rank)
+        A.fill(lambda m, n: dense[:, n*TS:(n+1)*TS])
+        B.fill(lambda m, n: np.zeros((1, TS), np.float32))
+        tp = DTDTaskpool(c, "dstencil")
+        insert_stencil1d_tasks(tp, A, B, ITERS)
+        tp.wait(timeout=30); tp.close(); c.wait(timeout=30); c.fini()
+        out = B if ITERS % 2 else A
+        return {n: np.asarray(out.data_of(0, n).newest_copy().payload)
+                for n in range(NT) if out.rank_of(0, n) == rank}
+
+    results = run_distributed(2, program, timeout=120)
+    ref = reference_stencil1d(dense, ITERS)
+    for out in results:
+        for n, tile in out.items():
+            np.testing.assert_allclose(tile, ref[:, n*8:(n+1)*8],
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_merge_sort(ctx):
+    rng = np.random.default_rng(22)
+    chunks = [rng.standard_normal(17).astype(np.float32) for _ in range(5)]
+    tp = DTDTaskpool(ctx, "msort")
+    result = merge_sort(tp, chunks)
+    tp.wait(); tp.close(); ctx.wait()
+    got = np.asarray(result.data.newest_copy().payload)
+    np.testing.assert_allclose(got, np.sort(np.concatenate(chunks)))
+
+
+def test_all2all(ctx):
+    N, TS = 4, 8
+    A = TiledMatrix("A2A", 1, N * TS, 1, TS)
+    B = TiledMatrix("B2A", 1, N * TS, 1, TS)
+    A.fill(lambda m, n: np.full((1, TS), float(n + 1), np.float32))
+    B.fill(lambda m, n: np.zeros((1, TS), np.float32))
+    tp = DTDTaskpool(ctx, "a2a")
+    assert all2all(tp, A, B) == N * N
+    tp.wait(); tp.close(); ctx.wait()
+    assert np.allclose(B.to_dense(), sum(range(1, N + 1)))
+
+
+def test_pingpong(ctx):
+    A = TiledMatrix("PP", 2 * 4, 4, 4, 4)
+    A.fill(lambda m, n: np.zeros((4, 4), np.float32))
+    tp = DTDTaskpool(ctx, "pp")
+    hops = 7
+    pingpong(tp, A, hops)
+    tp.wait(); tp.close(); ctx.wait()
+    final = A.data_of(hops % 2, 0).newest_copy()
+    assert np.allclose(np.asarray(final.payload), hops)
+
+
+def test_haar_tree(ctx):
+    tp = DTDTaskpool(ctx, "haar")
+    leaves = [tp.tile_new(np.full((1,), float(i), np.float32))
+              for i in range(8)]
+    roots = haar_transform(tp, leaves)
+    tp.wait(); tp.close(); ctx.wait()
+    top = np.asarray(roots[-1].data.newest_copy().payload)
+    assert np.allclose(top, np.mean(np.arange(8.0)))
+
+
+def test_recursive_device(ctx):
+    """A recursive-device task spawns a sub-taskpool; the parent completes
+    only after the nested DAG does (ref: PARSEC_DEV_RECURSIVE)."""
+    done = []
+
+    def builder(task):
+        sub = DTDTaskpool(ctx, f"sub{task.locals['k']}")
+        t = sub.tile_new((2, 2), np.float32)
+        for _ in range(3):
+            sub.insert_task(lambda x: x + 1.0, (t, RW))
+        def record(x):
+            done.append(task.locals["k"])
+            return None
+        sub.insert_task(record, (t, 0x1), jit=False)
+        sub.close()
+        return sub
+
+    tp = Taskpool("outer")
+    tc = TaskClass("R")
+    tc.add_flow(Flow("ctl", FLOW_ACCESS_CTL))
+    tc.count_mode = True
+    tc.add_chore(Chore(DEV_RECURSIVE, make_recursive_hook(builder)))
+    tp.add_task_class(tc)
+
+    def startup(stream, pool):
+        pool.set_nb_tasks(3)
+        return [Task(pool, tc, {"k": k}) for k in range(3)]
+
+    tp.startup_hook = startup
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert sorted(done) == [0, 1, 2]
+    assert tp.completed
+
+
+def test_sched_bench_runs():
+    import subprocess, sys, os, json
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks", "sched_bench.py"),
+         "2000", "lfq,ap"],
+        capture_output=True, text=True, timeout=110,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr[-1500:]
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert {l["sched"] for l in lines} == {"lfq", "ap"}
+    assert all(l["value"] > 0 for l in lines)
