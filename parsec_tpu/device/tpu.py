@@ -44,22 +44,33 @@ mca.register("device_tpu_max_bytes", 0,
              "HBM tile-heap budget in bytes (0 = 75% of reported, else 12GiB)", type=int)
 mca.register("device_tpu_max_inflight", 64,
              "Max concurrently dispatched device tasks", type=int)
+mca.register("device_tpu_batch_max", 16,
+             "Max compatible tasks collapsed into one batched dispatch", type=int)
+mca.register("device_tpu_over_cpu", False,
+             "TEST MODE: register the device module over a host jax device",
+             type=bool)
 
 
 class TPUTask:
     """Device-side task descriptor (ref: parsec_gpu_task_t, device_gpu.h:117-155)."""
 
     __slots__ = ("task", "submit", "stage_in", "stage_out", "pushout",
-                 "batchable", "load", "out_arrays", "complete_cb")
+                 "batchable", "batch_submit", "load", "out_arrays",
+                 "complete_cb")
 
     def __init__(self, task: Task, submit: Callable, stage_in=None,
-                 stage_out=None, pushout: int = 0, batchable: bool = False) -> None:
+                 stage_out=None, pushout: int = 0, batchable: bool = False,
+                 batch_submit: Optional[Callable] = None) -> None:
         self.task = task
         self.submit = submit          # submit(device, task, inputs)->outputs
         self.stage_in = stage_in      # optional override (ref: custom stage, stage_custom.jdf)
         self.stage_out = stage_out
         self.pushout = pushout        # bitmask of flows to push back to host now
         self.batchable = batchable
+        #: batch_submit(device, tasks, inputs_list) -> list of output tuples;
+        #: compatible queued tasks collapse into one dispatch
+        #: (ref: parsec_gpu_task_collect_batch, device_gpu.c:2229)
+        self.batch_submit = batch_submit
         self.load = 0.0
         self.out_arrays: Optional[Sequence[Any]] = None
         self.complete_cb: Optional[Callable] = None
@@ -81,6 +92,7 @@ class TPUDevice(DeviceModule):
         self._manager_lock = threading.Lock()  # the CAS mutex (device_gpu.c:3408)
         self._fifo_lock = threading.Lock()
         # LRU tile heap bookkeeping (ref: gpu_mem_lru / gpu_mem_owned_lru)
+        self.batched_dispatches = 0
         self._lru: "collections.OrderedDict[Any, DataCopy]" = collections.OrderedDict()
         self._resident_bytes = 0
         budget = mca.get("device_tpu_max_bytes", 0)
@@ -120,17 +132,40 @@ class TPUDevice(DeviceModule):
             completed = 0
             max_inflight = mca.get("device_tpu_max_inflight", 64)
             # kernel_push + kernel_exec phases (device_gpu.c:2746,2874)
+            batch_max = mca.get("device_tpu_batch_max", 16)
             while len(self._inflight) < max_inflight:
                 with self._fifo_lock:
                     if not self._pending:
                         break
+                    head = self._pending[0]
+                    # batchable head while the device is busy: let the batch
+                    # accumulate — deferral is free, the chip has work
+                    # (the collect discipline of parsec_gpu_task_collect_batch)
+                    if (head.batchable and head.batch_submit is not None and
+                            self._inflight and
+                            len(self._pending) < batch_max):
+                        break
                     gt = self._pending.popleft()
+                    group = [gt]
+                    # collect compatible pending tasks into one dispatch
+                    # (ref: parsec_gpu_task_collect_batch)
+                    if gt.batchable and gt.batch_submit is not None:
+                        while (self._pending and len(group) < batch_max and
+                               self._pending[0].batchable and
+                               self._pending[0].batch_submit == gt.batch_submit and
+                               self._pending[0].task.task_class is gt.task.task_class):
+                            group.append(self._pending.popleft())
                 try:
-                    self._submit_one(gt)
+                    if len(group) > 1:
+                        self._submit_group(group)
+                        self.batched_dispatches += 1
+                    else:
+                        self._submit_one(gt)
                 except Exception as e:
-                    self.load_sub(gt.load)
+                    for g in group:
+                        self.load_sub(g.load)
                     output.fatal(f"TPU submit failed for {gt.task!r}: {e}")
-                self._inflight.append(gt)
+                self._inflight.extend(group)
             # event polling + kernel_pop/epilog (device_gpu.c:2593,2944,3179)
             while self._inflight:
                 gt = self._inflight[0]
@@ -173,9 +208,21 @@ class TPUDevice(DeviceModule):
 
     def _submit_one(self, gt: TPUTask) -> None:
         task = gt.task
-        tc = task.task_class
+        inputs = self._gather_inputs(gt)
+        outs = gt.submit(self, task, inputs)
+        if outs is None:
+            outs = ()
+        elif not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        gt.out_arrays = outs
+
+    def _default_stage_in(self, data: Data, access: int) -> DataCopy:
+        return self._stage_in_copy(data, access)
+
+    def _gather_inputs(self, gt: TPUTask) -> List[Any]:
+        task = gt.task
         inputs: List[Any] = []
-        for flow in tc.flows:
+        for flow in task.task_class.flows:
             slot = task.data[flow.flow_index]
             if flow.access & FLOW_ACCESS_CTL or slot.data_in is None:
                 inputs.append(None)
@@ -188,15 +235,19 @@ class TPUDevice(DeviceModule):
                 inputs.append(dev_copy.payload)
             else:
                 inputs.append(self._jax.device_put(copy_in.payload, self.jax_device))
-        outs = gt.submit(self, task, inputs)
-        if outs is None:
-            outs = ()
-        elif not isinstance(outs, (tuple, list)):
-            outs = (outs,)
-        gt.out_arrays = outs
+        return inputs
 
-    def _default_stage_in(self, data: Data, access: int) -> DataCopy:
-        return self._stage_in_copy(data, access)
+    def _submit_group(self, group: List[TPUTask]) -> None:
+        """One dispatch for a batch of compatible independent tasks."""
+        inputs_list = [self._gather_inputs(g) for g in group]
+        outs_list = group[0].batch_submit(self, [g.task for g in group],
+                                          inputs_list)
+        for g, outs in zip(group, outs_list):
+            if outs is None:
+                outs = ()
+            elif not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            g.out_arrays = tuple(outs)
 
     def _epilog(self, stream, gt: TPUTask) -> None:
         """parsec_device_kernel_epilog (device_gpu.c:3179): attach outputs,
@@ -303,12 +354,18 @@ def discover_tpu_devices() -> List[TPUDevice]:
     import jax
     result: List[TPUDevice] = []
     done = threading.Event()
+    over_cpu = mca.get("device_tpu_over_cpu", False)
 
     def _probe() -> None:
         try:
             for d in jax.devices():
                 if d.platform in ("tpu", "gpu", "axon"):
                     result.append(TPUDevice(d))
+                elif over_cpu and d.platform == "cpu":
+                    # test mode: drive the full async device pipeline
+                    # (stage-in, LRU, events, batching) over a host device
+                    result.append(TPUDevice(d))
+                    break
         except Exception as e:
             output.debug_verbose(1, "device", f"jax.devices() failed: {e}")
         finally:

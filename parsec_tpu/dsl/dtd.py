@@ -123,6 +123,20 @@ _jit_cache: Dict[Any, Any] = {}
 _jit_cache_lock = threading.Lock()
 
 
+def _vmapped(fn: Callable):
+    """jit(vmap(fn)) cached per body function (batched dispatch path)."""
+    key = ("__vmap__", fn)
+    j = _jit_cache.get(key)
+    if j is None:
+        with _jit_cache_lock:
+            j = _jit_cache.get(key)
+            if j is None:
+                import jax
+                j = jax.jit(jax.vmap(fn))
+                _jit_cache[key] = j
+    return j
+
+
 def _jitted(fn: Callable):
     j = _jit_cache.get(fn)
     if j is None:
@@ -140,13 +154,17 @@ class DTDTaskClass(TaskClass):
     (ref: function_h_table, insert_function_internal.h:206-224)."""
 
     def __init__(self, name: str, fn: Callable, flow_accesses: Tuple[int, ...],
-                 nb_values: int, jit_ok: bool = True) -> None:
+                 nb_values: int, jit_ok: bool = True,
+                 batchable: bool = False) -> None:
         super().__init__(name, nb_flows=len(flow_accesses))
         self.fn = fn
         self.count_mode = True
         self.flow_accesses = flow_accesses
         #: False for side-effectful bodies (callbacks, host I/O): run eagerly
         self.jit_ok = jit_ok
+        #: True: compatible queued device tasks collapse into one vmapped
+        #: dispatch (ref: dtd GPU batching flag on task-class chores)
+        self.batchable = batchable
         for i, acc in enumerate(flow_accesses):
             self.add_flow(Flow(f"f{i}", acc))
 
@@ -227,16 +245,17 @@ class DTDTaskpool(Taskpool):
     # ------------------------------------------------------------- classes
     def _class_of(self, fn: Callable, flow_accesses: Tuple[int, ...],
                   nb_values: int, name: Optional[str],
-                  jit_ok: bool = True) -> DTDTaskClass:
-        key = (fn, flow_accesses, nb_values, jit_ok)
+                  jit_ok: bool = True, batchable: bool = False) -> DTDTaskClass:
+        key = (fn, flow_accesses, nb_values, jit_ok, batchable)
         tc = self._classes.get(key)
         if tc is None:
             tc = DTDTaskClass(name or getattr(fn, "__name__", "dtd_task"),
-                              fn, flow_accesses, nb_values, jit_ok=jit_ok)
+                              fn, flow_accesses, nb_values, jit_ok=jit_ok,
+                              batchable=batchable)
             tc.prepare_input = self._prepare_input
             tc.release_deps = self._release_deps
             tc.complete_execution = self._complete_execution
-            tc.add_chore(Chore(DEV_TPU, make_tpu_hook(self._tpu_submit)))
+            tc.add_chore(Chore(DEV_TPU, self._tpu_hook))
             tc.add_chore(Chore(DEV_CPU, self._cpu_hook))
             self.add_task_class(tc)
             self._classes[key] = tc
@@ -245,7 +264,7 @@ class DTDTaskpool(Taskpool):
     # ------------------------------------------------------------- insert
     def insert_task(self, fn: Callable, *args, priority: int = 0,
                     where: int = DEV_ALL, name: Optional[str] = None,
-                    jit: bool = True) -> Optional[DTDTask]:
+                    jit: bool = True, batch: bool = False) -> Optional[DTDTask]:
         """parsec_dtd_insert_task (ref: insert_function.c:3617).
 
         ``args``: ``(tile, access)`` tuples become data flows; anything else
@@ -274,7 +293,7 @@ class DTDTaskpool(Taskpool):
             else:
                 arg_spec.append(("value", a))
         tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec), name,
-                            jit_ok=jit)
+                            jit_ok=jit, batchable=batch)
         task = DTDTask(self, tc, priority)
         task.arg_spec = arg_spec
         task.tiles = tiles
@@ -452,6 +471,42 @@ class DTDTaskpool(Taskpool):
                 tile.data.bump_version(0)
                 task.data[i].data_out = host
         return HOOK_DONE
+
+    def _tpu_hook(self, stream, task: "DTDTask") -> int:
+        """TPU chore: enqueue on the selected device, with batching metadata
+        (plays the generated GPU hook role, jdf2c.c:6613)."""
+        from ..device.tpu import TPUTask, _run_inline
+        dev = task.selected_device
+        if dev is None or not isinstance(dev, TPUDevice):
+            return _run_inline(stream, task, self._tpu_submit)
+        tc: DTDTaskClass = task.task_class
+        batchable = tc.batchable and self._jittable(task)
+        gt = TPUTask(task, self._tpu_submit, batchable=batchable,
+                     batch_submit=self._tpu_batch_submit if batchable else None)
+        return dev.kernel_scheduler(stream, task, tpu_task=gt)
+
+    def _tpu_batch_submit(self, device: TPUDevice, tasks: List["DTDTask"],
+                          inputs_list: List[List[Any]]):
+        """One vmapped dispatch over a batch of compatible independent tasks
+        (they are mutually independent by construction: only dependency-free
+        tasks sit in the device queue)."""
+        import jax
+        import jax.numpy as jnp
+        tc: DTDTaskClass = tasks[0].task_class
+        vals_list = [self._gather_args(t, inp)
+                     for t, inp in zip(tasks, inputs_list)]
+        stacked = []
+        for i in range(len(vals_list[0])):
+            col = [np.asarray(v) if isinstance(v, (int, float)) else v
+                   for v in (vals[i] for vals in vals_list)]
+            stacked.append(jnp.stack(col))
+        vm = _vmapped(tc.fn)
+        outs = vm(*stacked)
+        if outs is None:
+            return [() for _ in tasks]
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return [tuple(o[i] for o in outs) for i in range(len(tasks))]
 
     def _tpu_submit(self, device: TPUDevice, task: DTDTask, inputs: List[Any]):
         """TPU chore body: call the jitted class function on device arrays.

@@ -34,18 +34,12 @@ def tile_gemm_chain(c, a_stack, b_stack):
 
     The task-batching analogue (ref: parsec_gpu_task_collect_batch,
     device_gpu.c:2229): a whole k-chain of compatible GEMM tasks collapses
-    into one device call; the scan keeps C in registers/VMEM across steps
-    instead of round-tripping HBM per tile.
+    into one device call. Backed by the Pallas kernel
+    (:func:`parsec_tpu.ops.pallas_kernels.gemm_chain`) which keeps C in
+    VMEM across all k steps; falls back to a lax.scan inside that module.
     """
-    import jax
-    import jax.numpy as jnp
-
-    def step(acc, ab):
-        a, b = ab
-        return acc + jnp.dot(a, b, preferred_element_type=jnp.float32).astype(acc.dtype), None
-
-    out, _ = jax.lax.scan(step, c, (a_stack, b_stack))
-    return out
+    from .pallas_kernels import gemm_chain
+    return gemm_chain(c, a_stack, b_stack)
 
 
 def insert_gemm_tasks(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,

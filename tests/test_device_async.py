@@ -1,0 +1,107 @@
+"""Device-module pipeline tests over a host jax device (test mode).
+
+Exercises the full async device path — kernel_scheduler enqueue, manager
+drive, version-checked stage-in, LRU residency, is_ready event polling,
+epilog write-back, and batched dispatch — without TPU hardware (the
+reference's analogue: device tests runnable on any CUDA-capable node).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+from parsec_tpu.utils import mca
+
+
+@pytest.fixture()
+def dctx():
+    mca.set("device_tpu_over_cpu", True)
+    c = Context(nb_cores=1)
+    yield c
+    c.fini()
+    mca.params.unset("device_tpu_over_cpu")
+
+
+def _tpu_dev(ctx):
+    from parsec_tpu.device.tpu import TPUDevice
+    devs = [d for d in ctx.devices.devices if isinstance(d, TPUDevice)]
+    assert devs, "device module did not register over the host device"
+    return devs[0]
+
+
+def test_async_device_pipeline(dctx):
+    dev = _tpu_dev(dctx)
+    A = TiledMatrix("AD", 32, 32, 16, 16)
+    rng = np.random.default_rng(40)
+    dense = rng.standard_normal((32, 32)).astype(np.float32)
+    A.fill(lambda m, n: dense[m*16:(m+1)*16, n*16:(n+1)*16])
+    tp = DTDTaskpool(dctx, "dev")
+    for m in range(2):
+        for n in range(2):
+            tp.insert_task(lambda x: x * 2.0, (tp.tile_of(A, m, n), RW))
+    tp.wait(); tp.close(); dctx.wait()
+    np.testing.assert_allclose(A.to_dense(), dense * 2.0, rtol=1e-5)
+    assert dev.executed_tasks == 4
+    assert dev.transfer_in_bytes > 0          # staged tiles in
+    assert len(dev._lru) > 0                  # resident copies tracked
+
+
+def test_device_chain_reuses_resident_tiles(dctx):
+    """Second pass over the same tiles must not re-stage (version match)."""
+    dev = _tpu_dev(dctx)
+    A = TiledMatrix("AR", 16, 16, 16, 16)
+    A.fill(lambda m, n: np.ones((16, 16), np.float32))
+    tp = DTDTaskpool(dctx, "resident")
+    t = tp.tile_of(A, 0, 0)
+    for _ in range(4):
+        tp.insert_task(lambda x: x + 1.0, (t, RW))
+    tp.wait(); tp.close(); dctx.wait()
+    staged_once = dev.transfer_in_bytes
+    assert staged_once == 16 * 16 * 4          # exactly one initial stage-in
+    assert np.allclose(np.asarray(t.data.newest_copy().payload), 5.0)
+
+
+def test_batched_dispatch(dctx):
+    """Independent same-class tasks collapse into vmapped dispatches
+    (ref: parsec_gpu_task_collect_batch). A host device completes work
+    instantly, so the batch window never fills on its own; holding the
+    manager lock during enqueue models a busy chip accumulating work."""
+    dev = _tpu_dev(dctx)
+    A = TiledMatrix("AB", 16 * 8, 16, 16, 16)
+    A.fill(lambda m, n: np.full((16, 16), float(m), np.float32))
+    tp = DTDTaskpool(dctx, "batch")
+
+    def scale(x):
+        return x * 3.0
+
+    for m in range(8):
+        tp.insert_task(scale, (tp.tile_of(A, m, 0), RW), batch=True)
+    # run the hooks (enqueue on the device) while the manager is "busy":
+    # progress is a no-op for everyone else, so the batch accumulates
+    with dev._manager_lock:
+        dctx._progress_loop(dctx.streams[0],
+                            until=lambda: len(dev._pending) == 8,
+                            timeout=10)
+    tp.wait(); tp.close(); dctx.wait()
+    for m in range(8):
+        assert np.allclose(np.asarray(A.data_of(m, 0).newest_copy().payload),
+                           3.0 * m)
+    assert dev.batched_dispatches >= 1
+
+
+def test_eviction_under_pressure(dctx):
+    """A tiny HBM budget forces LRU eviction with write-back."""
+    dev = _tpu_dev(dctx)
+    dev._budget = 3 * 16 * 16 * 4              # room for ~3 tiles
+    A = TiledMatrix("AE", 16 * 8, 16, 16, 16)
+    A.fill(lambda m, n: np.full((16, 16), float(m), np.float32))
+    tp = DTDTaskpool(dctx, "evict")
+    for m in range(8):
+        tp.insert_task(lambda x: x + 0.5, (tp.tile_of(A, m, 0), RW))
+    tp.wait(); tp.close(); dctx.wait()
+    for m in range(8):
+        assert np.allclose(np.asarray(A.data_of(m, 0).newest_copy().payload),
+                           m + 0.5)
+    assert dev._resident_bytes <= dev._budget + 16 * 16 * 4

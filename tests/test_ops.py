@@ -70,3 +70,44 @@ def test_potrf_larger_grid(ctx):
     ctx.wait()
     L = np.tril(A.to_dense())
     np.testing.assert_allclose(L @ L.T, spd, rtol=1e-2, atol=1e-2)
+
+
+def test_getrf_builder(ctx):
+    """Tiled LU (no pivoting) on a diagonally-dominant matrix."""
+    from parsec_tpu.ops.getrf import (getrf_flops, insert_getrf_tasks,
+                                      make_dd, unpack_lu)
+    n, ts = 96, 32
+    a = make_dd(n, seed=8)
+    A = _tiled_from(a, ts, "LU")
+    tp = DTDTaskpool(ctx, "getrf")
+    T = n // ts
+    ntasks = insert_getrf_tasks(tp, A)
+    assert ntasks == T + 2 * (T * (T - 1) // 2) + (T*(T-1)*(2*T-1))//6
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    packed = A.to_dense()
+    L, U = unpack_lu(packed)
+    np.testing.assert_allclose(L @ U, a, rtol=2e-2, atol=2e-2)
+    assert getrf_flops(10) == 2000.0 / 3.0
+
+
+def test_geqrf_builder(ctx):
+    """Tiled QR: R^T R must equal A^T A (Q orthogonal, implicit)."""
+    from parsec_tpu.ops.geqrf import insert_geqrf_tasks
+    n, ts = 64, 16
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    A = _tiled_from(a, ts, "QR")
+    tp = DTDTaskpool(ctx, "geqrf")
+    insert_geqrf_tasks(tp, A)
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    R = np.triu(A.to_dense())
+    np.testing.assert_allclose(R.T @ R, a.T @ a, rtol=5e-2, atol=5e-2)
+    # below-diagonal tiles must be (numerically) annihilated
+    for m in range(1, n // ts):
+        for k in range(m):
+            tile = np.asarray(A.data_of(m, k).newest_copy().payload)
+            assert np.abs(tile).max() < 1e-3

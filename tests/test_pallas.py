@@ -1,0 +1,55 @@
+"""Pallas kernel tests (interpret mode on the CPU backend)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.ops import pallas_kernels as PK
+
+
+def test_gemm_chain_matches_numpy():
+    rng = np.random.default_rng(30)
+    kt, ts = 4, 32
+    c = rng.standard_normal((ts, ts)).astype(np.float32)
+    a = rng.standard_normal((kt, ts, ts)).astype(np.float32)
+    b = rng.standard_normal((kt, ts, ts)).astype(np.float32)
+    out = np.asarray(PK.gemm_chain(c, a, b))
+    ref = c + sum(a[k] @ b[k] for k in range(kt))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_blocked_matmul():
+    rng = np.random.default_rng(31)
+    a = rng.standard_normal((128, 64)).astype(np.float32)
+    b = rng.standard_normal((64, 128)).astype(np.float32)
+    out = np.asarray(PK.matmul(a, b, block=(64, 64, 32)))
+    np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_blocked_matmul_odd_shapes_fallback():
+    rng = np.random.default_rng(32)
+    a = rng.standard_normal((100, 60)).astype(np.float32)
+    b = rng.standard_normal((60, 90)).astype(np.float32)
+    out = np.asarray(PK.matmul(a, b))
+    np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_stencil_kernel_matches_reference():
+    from parsec_tpu.ops.stencil import reference_stencil1d
+    rng = np.random.default_rng(33)
+    x = rng.standard_normal((1, 64)).astype(np.float32)
+    z = np.zeros_like(x)
+    out = np.asarray(PK.stencil1d(x, z, z))
+    ref = reference_stencil1d(x, 1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_stencil_kernel_with_halos():
+    rng = np.random.default_rng(34)
+    x = rng.standard_normal((1, 32)).astype(np.float32)
+    l = rng.standard_normal((1, 32)).astype(np.float32)
+    r = rng.standard_normal((1, 32)).astype(np.float32)
+    out = np.asarray(PK.stencil1d(x, l, r))
+    xm = np.concatenate([l[:, -1:], x[:, :-1]], axis=1)
+    xp = np.concatenate([x[:, 1:], r[:, :1]], axis=1)
+    np.testing.assert_allclose(out, 0.25 * xm + 0.5 * x + 0.25 * xp,
+                               rtol=1e-5, atol=1e-5)
