@@ -189,6 +189,36 @@ class RemoteDepEngine:
         """Shadow of a task executing elsewhere — nothing to run locally;
         bookkeeping happened during linking."""
 
+    # ------------------------------------------------------------ PTG path
+    def ptg_send(self, tp, tc, pkey, flow_index: int, payload,
+                 ranks: Sequence[int]) -> None:
+        """Ship a PTG task's output flow to the ranks hosting its remote
+        successors (the remote activation of parsec_release_dep_fct); the
+        receiver re-derives which local tasks it feeds from the replicated
+        program (the phantom-task trick of remote_dep_get_datatypes,
+        remote_dep_mpi.c:861)."""
+        import numpy as np
+        key = ("ptg", tp.name, tc.name, tuple(pkey) if isinstance(pkey, (list, tuple)) else pkey,
+               flow_index)
+        with self._lock:
+            ranks = [r for r in ranks if (key, 0, r) not in self._sent]
+            for r in ranks:
+                self._sent.add((key, 0, r))
+        if not ranks:
+            return
+        tp.addto_nb_pending_actions(1)
+        self._cmds.append(("ptg_send", tp, key, ranks, np.asarray(payload)))
+        self.ctx._work_event.set()
+
+    def _do_ptg_send(self, tp, key, ranks, payload) -> None:
+        algo = mca.get("comm_coll_bcast", "chain")
+        for child, subtree in bcast_children(ranks, self.ce.my_rank, algo):
+            hdr = {"ptg": True, "tp": key[1], "tc": key[2], "pkey": key[3],
+                   "flow": key[4], "forward": subtree, "eager": True,
+                   "key": key, "version": 0}
+            self.ce.send_am(TAG_REMOTE_DEP_ACTIVATE, child, hdr, payload)
+            self.fourcounter.message_sent(tp)
+
     # ------------------------------------------------------------ data path
     def send_data(self, tp, tile, version: int, ranks: Sequence[int],
                   payload: np.ndarray) -> None:
@@ -237,6 +267,9 @@ class RemoteDepEngine:
         tp = self._taskpools.get(hdr.get("tp"))
         if tp is not None:
             self.fourcounter.message_received(tp)
+        if hdr.get("ptg"):
+            self._ptg_arrived(tp, hdr, payload)
+            return
         if hdr.get("eager"):
             self._data_arrived(tp, hdr, payload, src)
         else:
@@ -295,6 +328,23 @@ class RemoteDepEngine:
         if ready:
             self.ctx.schedule(ready)
 
+    def _ptg_arrived(self, tp, hdr, payload) -> None:
+        key = tuple(hdr["key"]) if isinstance(hdr["key"], list) else hdr["key"]
+        # forward down the multicast tree
+        fwd = hdr.get("forward") or []
+        if fwd and tp is not None:
+            with self._lock:
+                fwd = [r for r in fwd if (key, 0, r) not in self._sent]
+                for r in fwd:
+                    self._sent.add((key, 0, r))
+            if fwd:
+                import numpy as np
+                self._cmds.append(("ptg_send", tp, key, fwd, np.asarray(payload)))
+        if tp is None:
+            output.warning(f"PTG payload for unknown taskpool {hdr.get('tp')!r}")
+            return
+        tp._ptg_data_arrived(hdr["tc"], hdr["pkey"], hdr["flow"], payload)
+
     # ------------------------------------------------------------ progress
     def progress(self) -> int:
         n = 0
@@ -308,6 +358,11 @@ class RemoteDepEngine:
                 self._do_send(tp, key, version, ranks, payload)
                 if tp is not None:
                     tp.addto_nb_pending_actions(-1)
+                n += 1
+            elif cmd[0] == "ptg_send":
+                _, tp, key, ranks, payload = cmd
+                self._do_ptg_send(tp, key, ranks, payload)
+                tp.addto_nb_pending_actions(-1)
                 n += 1
         n += self.ce.progress()
         n += self._termdet_progress()

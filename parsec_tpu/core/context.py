@@ -392,6 +392,8 @@ class Context:
                     out = slot.data_out if slot.data_out is not None else slot.data_in
                     entry.data[f.flow_index] = out
 
+        distributed = self.comm is not None and self.nb_ranks > 1
+
         def visit(dep, succ_locals: Dict[str, int]) -> bool:
             succ_tc = dep.task_class
             key = succ_tc.make_key(tp, succ_locals)
@@ -404,6 +406,7 @@ class Context:
             return True
 
         for flow in tc.flows:
+            remote_ranks = set()
             for dep in flow.deps_out:
                 if dep.cond is not None and not dep.cond(task.locals):
                     continue
@@ -413,8 +416,22 @@ class Context:
                 if isinstance(targets, dict):
                     targets = [targets]
                 for tl in targets:
+                    if distributed:
+                        r = tp.task_rank_of(dep.task_class, tl)
+                        if r != self.my_rank:
+                            # remote successor: ship this flow's output once
+                            # per destination (the remote activation fork of
+                            # parsec_release_dep_fct)
+                            remote_ranks.add(r)
+                            continue
                     visit(dep, tl)
                     nb_uses += 1
+            if remote_ranks:
+                slot = task.data[flow.flow_index]
+                out = slot.data_out if slot.data_out is not None else slot.data_in
+                payload = out.payload if hasattr(out, "payload") else out
+                self.comm.ptg_send(tp, tc, task.key, flow.flow_index,
+                                   payload, sorted(remote_ranks))
         if entry is not None:
             repo.entry_addto_usage_limit(task.key, max(nb_uses, 1))
         # consume source repo entries (one use each)

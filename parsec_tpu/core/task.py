@@ -345,6 +345,14 @@ class Taskpool:
             self._deps[tc.task_class_id] = table
             return table
 
+    def task_rank_of(self, tc: TaskClass, locals_: Dict[str, int]) -> int:
+        """Owner-computes rank of a task instance; 0/my-rank when the
+        taskpool has no distribution (overridden by distributed DSLs)."""
+        rank_of = getattr(tc, "_ptg_rank_of", None)
+        if rank_of is not None:
+            return rank_of(locals_)
+        return self.context.my_rank if self.context is not None else 0
+
     def dep_state(self, tc: TaskClass, key: Any) -> int:
         table = self._deps[tc.task_class_id]
         if table is None:

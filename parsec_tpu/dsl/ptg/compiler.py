@@ -122,7 +122,15 @@ class PTGTaskpool(Taskpool):
                    if g not in globals_ and g not in collections]
         if missing:
             output.fatal(f"PTG taskpool {self.name}: missing globals {missing}")
+        #: (tc_name, pkey, flow_index) -> payload shipped from a remote
+        #: producer (consumed by prepare_input)
+        self._ptg_received: Dict[Tuple, Any] = {}
+        self._ptg_lock = threading.Lock()
         self._build()
+        if ctx.comm is not None and ctx.nb_ranks > 1:
+            # distributed PTG: global termination + name-keyed routing
+            ctx.comm.fourcounter.monitor_taskpool(self)
+            ctx.comm.register_taskpool(self)
 
     # ------------------------------------------------------------------ build
     def _build(self) -> None:
@@ -325,13 +333,25 @@ class PTGTaskpool(Taskpool):
                     peer = self._classes[ep["name"]]
                     peer_spec = self.program.spec.task_class(ep["name"])
                     pkey = tuple(ex.values(env)[0] for ex in ep["exprs"])
+                    pf_idx = next(i for i, f in enumerate(peer_spec.flows)
+                                  if f.name == ep["flow"])
+                    plocals = dict(zip(peer_spec.params, pkey))
+                    if (self.ctx.nb_ranks > 1 and self.ctx.comm is not None
+                            and self.task_rank_of(peer, plocals) != self.ctx.my_rank):
+                        # remote producer: payload was shipped by its rank
+                        with self._ptg_lock:
+                            payload = self._ptg_received.get(
+                                (ep["name"], pkey, pf_idx))
+                        if payload is None:
+                            output.fatal(f"{task!r}: remote payload "
+                                         f"{ep['name']}{pkey} missing")
+                        slot.data_in = DataCopy(None, 0, payload)
+                        continue
                     repo = self.repos[peer.task_class_id]
                     entry = repo.lookup_entry(pkey)
                     if entry is None:
                         output.fatal(f"{task!r}: missing repo entry "
                                      f"{ep['name']}{pkey}")
-                    pf_idx = next(i for i, f in enumerate(peer_spec.flows)
-                                  if f.name == ep["flow"])
                     slot.data_in = entry.data[pf_idx]
                     slot.source_repo_entry = entry
                 elif ep["kind"] == "new":
@@ -439,6 +459,37 @@ class PTGTaskpool(Taskpool):
         raw = ns["__ptg_body__"]
         import jax
         return jax.jit(raw)
+
+    def _ptg_data_arrived(self, tc_name: str, pkey, flow_index: int,
+                          payload) -> None:
+        """A remote producer's output landed here: credit every local
+        successor it feeds, re-deriving them from the replicated program
+        (the reference's phantom-task iterate-successors,
+        remote_dep_mpi.c:861)."""
+        pkey = tuple(pkey) if isinstance(pkey, (list, tuple)) else (pkey,)
+        with self._ptg_lock:
+            self._ptg_received[(tc_name, pkey, flow_index)] = payload
+        tc = self._classes[tc_name]
+        tcs = self.program.spec.task_class(tc_name)
+        plocals = dict(zip(tcs.params, pkey))
+        my = self.ctx.my_rank
+        ready = []
+        flow = tc.flows[flow_index]
+        for dep in flow.deps_out:
+            if dep.cond is not None and not dep.cond(plocals):
+                continue
+            targets = dep.target_locals(plocals) if dep.target_locals else [plocals]
+            for tl in targets:
+                succ_tc = dep.task_class
+                if self.task_rank_of(succ_tc, tl) != my:
+                    continue
+                key = succ_tc.make_key(self, tl)
+                goal = (succ_tc.dependencies_goal_fn(tl)
+                        if succ_tc.dependencies_goal_fn else None)
+                if self.update_deps(succ_tc, key, 1, goal):
+                    ready.append(self.ctx.make_task(self, succ_tc, dict(tl)))
+        if ready:
+            self.ctx.schedule(ready)
 
     # ------------------------------------------------------------------ startup
     def _enumerate(self):

@@ -193,3 +193,100 @@ def test_rendezvous_large_payload():
         np.testing.assert_allclose(results[0], expect, rtol=1e-4, atol=1e-4)
     finally:
         mca.params.unset("comm_eager_limit")
+
+
+DISTRIBUTED_GEMM_PTG = """
+// DPLASMA-style distributed GEMM: READ tasks at the data owners broadcast
+// panels to the GEMM tasks (memory reads stay rank-local; cross-rank
+// movement is task->task dataflow riding the multicast trees)
+%global MT
+%global NT
+%global KT
+%global descA
+%global descB
+%global descC
+
+RA(m, k)
+  m = 0 .. MT-1
+  k = 0 .. KT-1
+  : descA(m, k)
+  READ A <- descA(m, k)
+       -> A GEMM(m, 0 .. NT-1, k)
+BODY
+  A = A
+END
+
+RB(k, n)
+  k = 0 .. KT-1
+  n = 0 .. NT-1
+  : descB(k, n)
+  READ B <- descB(k, n)
+       -> B GEMM(0 .. MT-1, n, k)
+BODY
+  B = B
+END
+
+GEMM(m, n, k)
+  m = 0 .. MT-1
+  n = 0 .. NT-1
+  k = 0 .. KT-1
+  : descC(m, n)
+  priority = KT - k
+  READ A <- A RA(m, k)
+  READ B <- B RB(k, n)
+  RW   C <- (k == 0) ? descC(m, n) : C GEMM(m, n, k-1)
+       -> (k < KT-1) ? C GEMM(m, n, k+1) : descC(m, n)
+BODY [type=TPU]
+  C = C + jnp.dot(A, B, preferred_element_type=jnp.float32)
+END
+"""
+
+
+@pytest.mark.parametrize("nb_ranks", [2, 4])
+def test_distributed_ptg_gemm(nb_ranks):
+    """Distributed PTG (the reference's primary mode): owner-computes task
+    placement, cross-rank dataflow with multicast, fourcounter termination."""
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    MT = NT = KT = 4
+    TS = 8
+    rng = np.random.default_rng(50)
+    a = rng.standard_normal((MT*TS, KT*TS)).astype(np.float32)
+    b = rng.standard_normal((KT*TS, NT*TS)).astype(np.float32)
+    prog = compile_ptg(DISTRIBUTED_GEMM_PTG, "dgemm_ptg")
+
+    def program(rank, fabric):
+        ctx = _mkctx(rank, fabric)
+        P_, Q_ = (2, nb_ranks // 2)
+        kw = dict(nodes=nb_ranks, myrank=rank, P=P_, Q=Q_)
+        A = TwoDimBlockCyclic("dA", MT*TS, KT*TS, TS, TS, **kw)
+        B = TwoDimBlockCyclic("dB", KT*TS, NT*TS, TS, TS, **kw)
+        C = TwoDimBlockCyclic("dC", MT*TS, NT*TS, TS, TS, **kw)
+        A.fill(lambda m, k: a[m*TS:(m+1)*TS, k*TS:(k+1)*TS])
+        B.fill(lambda k, n: b[k*TS:(k+1)*TS, n*TS:(n+1)*TS])
+        C.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        tp = prog.instantiate(ctx, globals={"MT": MT, "NT": NT, "KT": KT},
+                              collections={"descA": A, "descB": B, "descC": C},
+                              name="dgemm_ptg")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        ok = tp.completed
+        ctx.fini()
+        out = {}
+        for m in range(MT):
+            for n in range(NT):
+                if C.rank_of(m, n) == rank:
+                    out[(m, n)] = np.asarray(C.data_of(m, n).newest_copy().payload)
+        return ok, out
+
+    results = run_distributed(nb_ranks, program, timeout=180)
+    ref = a @ b
+    assert all(ok for ok, _ in results)
+    full = {}
+    for _, out in results:
+        full.update(out)
+    assert len(full) == MT * NT
+    for (m, n), tile in full.items():
+        np.testing.assert_allclose(tile, ref[m*TS:(m+1)*TS, n*TS:(n+1)*TS],
+                                   rtol=1e-3, atol=1e-3)
