@@ -84,6 +84,13 @@ def main() -> None:
         tp.wait()
         tp.close()
         ctx.wait()
+        # JAX dispatch is async: block on every output tile before stopping
+        # the clock
+        for m in range(mt):
+            for n in range(mt):
+                p = C.data_of(m, n).newest_copy().payload
+                if hasattr(p, "block_until_ready"):
+                    p.block_until_ready()
         return time.perf_counter() - t0
 
     run_once()          # warm: compiles the fused chain, stages tiles into HBM

@@ -79,9 +79,19 @@ def insert_gemm_tasks(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,
 @functools.lru_cache(maxsize=None)
 def _gemm_chain_body(kt: int):
     """One body function object per k-chain length: jit traces/compiles once
-    per (kt, tile shape) across all taskpools and benchmark repetitions."""
+    per (kt, tile shape) across all taskpools and benchmark repetitions.
+
+    Short chains unroll the dots directly (no stacking copies: XLA chains
+    the MXU calls on the accumulator); long chains stack once and ride the
+    Pallas VMEM-resident kernel."""
     def gemm_k(c, *abs_):
         import jax.numpy as jnp
+        if kt <= 16:
+            for k in range(kt):
+                c = c + jnp.dot(abs_[k], abs_[kt + k],
+                                preferred_element_type=jnp.float32
+                                ).astype(c.dtype)
+            return c
         a_stack = jnp.stack(abs_[:kt])
         b_stack = jnp.stack(abs_[kt:])
         return tile_gemm_chain(c, a_stack, b_stack)
