@@ -22,6 +22,24 @@ from .data.matrix import TiledMatrix
 from .dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
 
 
+# module-level bodies: one task class + one jit compilation each (loop-local
+# lambdas would mint a class and an XLA executable per insertion)
+def _acc_add(d, s):
+    return d + s
+
+
+def _bounce(d, s):
+    return s + 1.0
+
+
+def _pair_mean(o, a, b):
+    return (a + b) * 0.5
+
+
+def _merge_sorted(_o, x, y):
+    return np.sort(np.concatenate([np.asarray(x), np.asarray(y)]))
+
+
 def merge_sort(tp: DTDTaskpool, chunks: List[np.ndarray]):
     """Sort the concatenation of ``chunks`` through a DTD task tree.
 
@@ -45,10 +63,7 @@ def merge_sort(tp: DTDTaskpool, chunks: List[np.ndarray]):
             a, b = round_tiles[i], round_tiles[i + 1]
             out = tp.tile_new((1,), np.float32)
 
-            def do_merge(_o, x, y):
-                return np.sort(np.concatenate([np.asarray(x), np.asarray(y)]))
-
-            tp.insert_task(do_merge, (out, RW), (a, READ), (b, READ),
+            tp.insert_task(_merge_sorted, (out, RW), (a, READ), (b, READ),
                            name="merge", jit=False)
             nxt.append(out)
         if len(round_tiles) % 2:
@@ -63,7 +78,7 @@ def all2all(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix) -> int:
     n0 = tp.inserted
     for j in range(B.nt):
         for i in range(A.nt):
-            tp.insert_task(lambda d, s: d + s,
+            tp.insert_task(_acc_add,
                            (tp.tile_of(B, 0, j), RW | AFFINITY),
                            (tp.tile_of(A, 0, i), READ), name="a2a")
     return tp.inserted - n0
@@ -77,7 +92,7 @@ def pingpong(tp: DTDTaskpool, A: TiledMatrix, hops: int) -> int:
     t0, t1 = tp.tile_of(A, 0, 0), tp.tile_of(A, 1, 0)
     src, dst = t0, t1
     for _ in range(hops):
-        tp.insert_task(lambda d, s: s + 1.0, (dst, RW | AFFINITY), (src, READ),
+        tp.insert_task(_bounce, (dst, RW | AFFINITY), (src, READ),
                        name="pingpong")
         src, dst = dst, src
     return tp.inserted - n0
@@ -92,7 +107,7 @@ def haar_transform(tp: DTDTaskpool, leaves: List) -> List:
         nxt = []
         for i in range(0, len(level) - 1, 2):
             out = tp.tile_new(np.zeros((1,), np.float32))
-            tp.insert_task(lambda o, a, b: (a + b) * 0.5,
+            tp.insert_task(_pair_mean,
                            (out, RW), (level[i], READ), (level[i + 1], READ),
                            name="haar")
             nxt.append(out)

@@ -301,6 +301,7 @@ class RemoteDepEngine:
                 for r in fwd:
                     self._sent.add((key, version, r))
             if fwd:
+                tp.addto_nb_pending_actions(1)
                 self._cmds.append(("send", tp, key, version, fwd,
                                    np.asarray(payload)))
         waiters: List[Tuple] = []
@@ -339,6 +340,7 @@ class RemoteDepEngine:
                     self._sent.add((key, 0, r))
             if fwd:
                 import numpy as np
+                tp.addto_nb_pending_actions(1)
                 self._cmds.append(("ptg_send", tp, key, fwd, np.asarray(payload)))
         if tp is None:
             output.warning(f"PTG payload for unknown taskpool {hdr.get('tp')!r}")
@@ -364,6 +366,15 @@ class RemoteDepEngine:
                 self._do_ptg_send(tp, key, ranks, payload)
                 tp.addto_nb_pending_actions(-1)
                 n += 1
+            elif cmd[0] == "requeue_token":
+                token = cmd[1]
+                if token.get("tp") in self._taskpools:
+                    self._on_termdet(self.ce, -1, token, None)
+                    n += 1
+                else:
+                    # still unregistered: park again and yield this round
+                    self._cmds.append(cmd)
+                    break
         n += self.ce.progress()
         n += self._termdet_progress()
         return n

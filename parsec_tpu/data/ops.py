@@ -17,6 +17,10 @@ from ..dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
 from .matrix import TiledMatrix
 
 
+def _copy_src(dst, s):
+    return s
+
+
 def apply(tp: DTDTaskpool, A: TiledMatrix,
           op: Callable[[int, int, Any], Any], uplo: str = "full") -> int:
     """Apply ``op(m, n, tile) -> tile`` to every tile (ref: apply.jdf).
@@ -101,7 +105,7 @@ def broadcast(tp: DTDTaskpool, A: TiledMatrix, root: tuple = (0, 0)) -> int:
         for n in range(A.nt):
             if (m, n) == root:
                 continue
-            tp.insert_task(lambda dst, s: s,
+            tp.insert_task(_copy_src,
                            (tp.tile_of(A, m, n), RW | AFFINITY), (src, READ),
                            name="bcast")
     return tp.inserted - n0

@@ -23,6 +23,12 @@ from ..dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
 from .matrix import TiledMatrix
 
 
+def _frag_copy(dst, src, sr, sc, tr, tc, h, w):
+    out = np.array(dst, copy=True)
+    out[tr:tr + h, tc:tc + w] = np.asarray(src)[sr:sr + h, sc:sc + w]
+    return out
+
+
 def redistribute(tp: DTDTaskpool, S: TiledMatrix, T: TiledMatrix,
                  m: Optional[int] = None, n: Optional[int] = None,
                  si: int = 0, sj: int = 0, ti: int = 0, tj: int = 0) -> int:
@@ -65,14 +71,8 @@ def redistribute(tp: DTDTaskpool, S: TiledMatrix, T: TiledMatrix,
                     tr, tc = ti + fr0 - tm * T.mb, tj + fc0 - tn * T.nb
                     h, w = fr1 - fr0, fc1 - fc0
 
-                    def frag_copy(dst, src, _sr=sr, _sc=sc, _tr=tr, _tc=tc,
-                                  _h=h, _w=w):
-                        out = np.array(dst, copy=True)
-                        out[_tr:_tr + _h, _tc:_tc + _w] = \
-                            np.asarray(src)[_sr:_sr + _h, _sc:_sc + _w]
-                        return out
-
-                    tp.insert_task(frag_copy, (dst_tile, RW | AFFINITY),
+                    tp.insert_task(_frag_copy, (dst_tile, RW | AFFINITY),
                                    (tp.tile_of(S, sm, sn), READ),
+                                   sr, sc, tr, tc, h, w,
                                    name="redistribute", jit=False)
     return tp.inserted - n0

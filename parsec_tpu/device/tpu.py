@@ -238,10 +238,19 @@ class TPUDevice(DeviceModule):
         return inputs
 
     def _submit_group(self, group: List[TPUTask]) -> None:
-        """One dispatch for a batch of compatible independent tasks."""
+        """One dispatch for a batch of compatible independent tasks; ragged
+        batches (e.g. boundary tiles of a different shape) fall back to
+        per-task submission instead of failing the run."""
         inputs_list = [self._gather_inputs(g) for g in group]
-        outs_list = group[0].batch_submit(self, [g.task for g in group],
-                                          inputs_list)
+        try:
+            outs_list = group[0].batch_submit(self, [g.task for g in group],
+                                              inputs_list)
+        except Exception as e:  # noqa: BLE001 - ragged shapes etc.
+            output.debug_verbose(2, "device",
+                                 f"batch of {len(group)} fell back: {e}")
+            for g in group:
+                self._submit_one(g)
+            return
         for g, outs in zip(group, outs_list):
             if outs is None:
                 outs = ()

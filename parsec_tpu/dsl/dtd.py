@@ -57,6 +57,11 @@ mca.register("dtd_threshold_size", 1024,
              "Catch-up target once the window is hit", type=int)
 
 
+def _flush_body(arr):
+    """data_flush task body: force device->host materialization."""
+    return np.asarray(arr)
+
+
 class DTDTile:
     """Ref: parsec_dtd_tile_t (insert_function_internal.h:174-196)."""
 
@@ -552,9 +557,7 @@ class DTDTaskpool(Taskpool):
         """parsec_dtd_data_flush (ref: parsec_dtd_data_flush.c): insert a task
         that writes the tile's newest version back home (host copy of the
         owner)."""
-        def _flush(arr):
-            return np.asarray(arr)  # forces device->host materialization
-        self.insert_task(_flush, (tile, RW), name="dtd_flush", jit=False)
+        self.insert_task(_flush_body, (tile, RW), name="dtd_flush", jit=False)
 
     def data_flush_all(self, dc: DataCollection) -> None:
         """parsec_dtd_data_flush_all: flush every tile of ``dc`` seen so far."""

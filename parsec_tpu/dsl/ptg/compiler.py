@@ -508,7 +508,8 @@ class PTGTaskpool(Taskpool):
             param, lo, hi, step = ranges[i]
             env = self._env(loc)
             lo_v, hi_v, st_v = int(lo(env)), int(hi(env)), int(step(env))
-            for v in range(lo_v, hi_v + 1, st_v):   # inclusive, like JDF
+            end = hi_v + 1 if st_v > 0 else hi_v - 1
+            for v in range(lo_v, end, st_v):        # inclusive, like JDF
                 loc[param] = v
                 yield from rec(i + 1, loc)
             loc.pop(param, None)

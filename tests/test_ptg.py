@@ -340,3 +340,25 @@ def test_negative(case):
             prog.instantiate(ctx, globals={}, collections={"A": None})
         finally:
             ctx.fini()
+
+
+def test_descending_range(ctx):
+    """Negative-step ranges include both endpoints (countdown chains)."""
+    src = """
+%global A
+T(k)
+  k = 3 .. 0 .. -1
+  : A(0, 0)
+  RW X <- (k == 3) ? A(0, 0) : X T(k+1)
+     -> (k > 0) ? X T(k-1) : A(0, 0)
+BODY
+  X = X + 1.0
+END
+"""
+    A = TiledMatrix("A", 4, 4, 4, 4)
+    A.fill(lambda m, n: np.zeros((4, 4), np.float32))
+    tp = compile_ptg(src, "down").instantiate(ctx, globals={}, collections={"A": A})
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert tp.completed
+    assert np.allclose(A.to_dense(), 4.0)   # k = 3,2,1,0 all ran
