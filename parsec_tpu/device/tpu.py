@@ -162,9 +162,23 @@ class TPUDevice(DeviceModule):
                     else:
                         self._submit_one(gt)
                 except Exception as e:
-                    for g in group:
-                        self.load_sub(g.load)
-                    output.fatal(f"TPU submit failed for {gt.task!r}: {e}")
+                    if _is_oom(e):
+                        # out of HBM: evict and retry; if still starved,
+                        # bounce the tasks back to the scheduler (the
+                        # OOM -> HOOK_AGAIN discipline of device_gpu.c)
+                        self.evict_bytes(max(self._resident_bytes // 2, 1))
+                        try:
+                            for g in group:
+                                self._submit_one(g)
+                        except Exception:
+                            for g in group:
+                                self.load_sub(g.load)
+                                self.context.schedule([g.task])
+                            continue
+                    else:
+                        for g in group:
+                            self.load_sub(g.load)
+                        output.fatal(f"TPU submit failed for {gt.task!r}: {e}")
                 self._inflight.extend(group)
             # event polling + kernel_pop/epilog (device_gpu.c:2593,2944,3179)
             while self._inflight:
@@ -313,6 +327,32 @@ class TPUDevice(DeviceModule):
             self._resident_bytes += _nbytes(copy.payload)
         self._lru[key] = copy
 
+    def evict_bytes(self, nbytes: int) -> int:
+        """Force eviction of about ``nbytes`` of resident clean/dirty copies
+        (the explicit half of the OOM retry path)."""
+        target = max(0, self._resident_bytes - nbytes)
+        freed0 = self._resident_bytes
+        while self._resident_bytes > target and self._lru:
+            before = self._resident_bytes
+            self._reserve(self._budget)  # no-op unless over budget
+            # direct eviction of the LRU head
+            for key in list(self._lru):
+                copy = self._lru[key]
+                if copy.readers > 0:
+                    continue
+                data = copy.original
+                if data is not None and copy.coherency_state == COHERENCY_OWNED \
+                        and data.newest_copy() is copy:
+                    self._stage_out(data, copy)
+                self._lru.pop(key)
+                self._resident_bytes -= _nbytes(copy.payload)
+                copy.coherency_state = COHERENCY_INVALID
+                copy.payload = None
+                break
+            if self._resident_bytes == before:
+                break
+        return freed0 - self._resident_bytes
+
     def _reserve(self, nbytes: int) -> None:
         """Evict LRU copies until ``nbytes`` fits the budget
         (ref: parsec_device_data_reserve_space device_gpu.c:1210)."""
@@ -338,6 +378,11 @@ class TPUDevice(DeviceModule):
     def fini(self) -> None:
         self._lru.clear()
         self._pending.clear()
+
+
+def _is_oom(e: Exception) -> bool:
+    msg = str(e).upper()
+    return "RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg or "OOM" in msg
 
 
 def _nbytes(arr) -> int:

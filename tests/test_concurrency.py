@@ -1,0 +1,119 @@
+"""Multi-worker stress: the same DAGs under real thread concurrency.
+
+Everything else runs nb_cores=1; these tests run 4 worker threads per
+context (and 2 per rank distributed) to shake out races in the scheduler,
+tile chains, dep counters and the device manager try-lock."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW, AFFINITY
+from parsec_tpu.ops.gemm import insert_gemm_tasks
+from parsec_tpu.ops.potrf import insert_potrf_tasks, make_spd
+
+
+@pytest.mark.parametrize("sched", ["lfq", "ap"])
+def test_gemm_four_workers(sched):
+    ctx = Context(nb_cores=4, scheduler=sched)
+    n, ts = 128, 32
+    rng = np.random.default_rng(60)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A = TiledMatrix("A4", n, n, ts, ts)
+    B = TiledMatrix("B4", n, n, ts, ts)
+    C = TiledMatrix("C4", n, n, ts, ts)
+    A.fill(lambda m, k: a[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    B.fill(lambda k, j: b[k*ts:(k+1)*ts, j*ts:(j+1)*ts])
+    C.fill(lambda m, j: np.zeros((ts, ts), np.float32))
+    tp = DTDTaskpool(ctx, "gemm4")
+    insert_gemm_tasks(tp, A, B, C)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=60)
+    ctx.fini()
+    np.testing.assert_allclose(C.to_dense(), a @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_potrf_four_workers():
+    ctx = Context(nb_cores=4)
+    n, ts = 128, 32
+    spd = make_spd(n, seed=61)
+    A = TiledMatrix("P4", n, n, ts, ts)
+    A.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    tp = DTDTaskpool(ctx, "potrf4")
+    insert_potrf_tasks(tp, A)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=60)
+    ctx.fini()
+    L = np.tril(A.to_dense())
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-2, atol=1e-2)
+
+
+def test_distributed_two_workers_each():
+    """2 ranks x 2 worker threads: comm progress (master only) under
+    concurrent execution."""
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+
+    N, TS = 64, 16
+    rng = np.random.default_rng(62)
+    a = rng.standard_normal((N, N)).astype(np.float32)
+    b = rng.standard_normal((N, N)).astype(np.float32)
+
+    def program(rank, fabric):
+        ctx = Context(nb_cores=2, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        kw = dict(nodes=2, myrank=rank, P=2, Q=1)
+        A = TwoDimBlockCyclic("A2w", N, N, TS, TS, **kw)
+        B = TwoDimBlockCyclic("B2w", N, N, TS, TS, **kw)
+        C = TwoDimBlockCyclic("C2w", N, N, TS, TS, **kw)
+        A.fill(lambda m, k: a[m*TS:(m+1)*TS, k*TS:(k+1)*TS])
+        B.fill(lambda k, j: b[k*TS:(k+1)*TS, j*TS:(j+1)*TS])
+        C.fill(lambda m, j: np.zeros((TS, TS), np.float32))
+        tp = DTDTaskpool(ctx, "gemm2w")
+        insert_gemm_tasks(tp, A, B, C)
+        tp.wait(timeout=60)
+        tp.close()
+        ctx.wait(timeout=60)
+        ctx.fini()
+        return {(m, j): np.asarray(C.data_of(m, j).newest_copy().payload)
+                for m in range(C.mt) for j in range(C.nt)
+                if C.rank_of(m, j) == rank}
+
+    results = run_distributed(2, program, timeout=180)
+    ref = a @ b
+    full = {}
+    for o in results:
+        full.update(o)
+    for (m, j), tile in full.items():
+        np.testing.assert_allclose(tile, ref[m*TS:(m+1)*TS, j*TS:(j+1)*TS],
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_untied_tasks_insert_from_body():
+    """A task body inserting more tasks into its own taskpool (the untied
+    tasks-inserting-tasks pattern of the reference's DTD tests)."""
+    ctx = Context(nb_cores=2)
+    tp = DTDTaskpool(ctx, "untied")
+    t = tp.tile_new((2, 2), np.float32)
+    spawned = []
+
+    def child(x):
+        spawned.append(1)
+        return x + 1.0
+
+    def parent(x):
+        for _ in range(3):
+            tp.insert_task(child, (t, RW), jit=False)
+        return x + 1.0
+
+    tp.insert_task(parent, (t, RW), jit=False)
+    tp.wait(timeout=30)
+    tp.close()
+    ctx.wait(timeout=30)
+    ctx.fini()
+    assert len(spawned) == 3
+    assert np.allclose(np.asarray(t.data.newest_copy().payload), 4.0)

@@ -1,0 +1,32 @@
+"""XLA profiler bridge smoke test (the NVTX-swap role)."""
+
+import glob
+import os
+
+import numpy as np
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+from parsec_tpu.utils.xla_trace import TaskAnnotator, xla_trace
+
+
+def test_xla_trace_capture(tmp_path):
+    ctx = Context(nb_cores=1)
+    ann = TaskAnnotator()
+    ann.enable(ctx)
+    logdir = str(tmp_path / "tb")
+    with xla_trace(logdir):
+        tp = DTDTaskpool(ctx, "xt")
+        t = tp.tile_new((8, 8), np.float32)
+        for _ in range(4):
+            tp.insert_task(lambda x: x * 1.5, (t, RW))
+        tp.wait(); tp.close(); ctx.wait()
+    ctx.fini()
+    # a profile directory with at least one trace artifact exists
+    produced = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in produced), produced
+
+
+def test_xla_trace_noop_without_dir():
+    with xla_trace(None):
+        pass  # must be a clean no-op
