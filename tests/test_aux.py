@@ -236,3 +236,37 @@ def test_reshape_promise_shared():
     assert r1.payload.shape == (4, 3) and str(r1.payload.dtype) == "float64"
     noop = ReshapeSpec()
     assert cache.get_reshaped(copy, noop) is copy
+
+
+def test_subtile_recursive_potrf(ctx):
+    """A coarse tile factored by a nested taskpool over its subtile view
+    (ref: subtile.c + PARSEC_DEV_RECURSIVE composition)."""
+    from parsec_tpu.data.subtile import SubtileCollection
+    from parsec_tpu.ops.potrf import insert_potrf_tasks, make_spd
+
+    n = 64
+    spd = make_spd(n, seed=15)
+    A = TiledMatrix("big", n, n, n, n)     # ONE coarse tile
+    A.fill(lambda m, k: spd)
+    parent = A.data_of(0, 0)
+
+    sub = SubtileCollection(parent, 16, 16, name="sub")
+    tp = DTDTaskpool(ctx, "subpotrf")
+    insert_potrf_tasks(tp, sub)
+    tp.wait(); tp.close(); ctx.wait()
+    sub.flush()
+    L = np.tril(np.asarray(parent.newest_copy().payload))
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-2, atol=1e-2)
+
+
+def test_info_registry():
+    from parsec_tpu.utils.info import InfoBag, InfoRegistry
+    reg = InfoRegistry()
+    a = reg.register("dsl.cache")
+    b = reg.register("tool.state")
+    assert reg.register("dsl.cache") == a   # idempotent
+    assert a != b
+    bag = InfoBag()
+    bag.set(b, {"x": 1})
+    assert bag.get(b) == {"x": 1}
+    assert bag.get(a, "none") == "none"
