@@ -26,14 +26,31 @@ def log(msg):
 
 
 def main() -> None:
+    import subprocess
+
     import numpy as np
     import jax
 
+    # probe the accelerator in a SUBPROCESS under a hard timeout: a wedged
+    # TPU transport would hang any in-process backend init (and hold JAX's
+    # backend lock), so the decision must be made before this process
+    # touches a backend at all
     try:
-        devs = jax.devices()
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=120)
+        platform = probe.stdout.strip().splitlines()[-1] if probe.returncode == 0 \
+            and probe.stdout.strip() else ""
     except Exception:
-        jax.config.update("jax_platforms", "cpu")
-        devs = jax.devices()
+        platform = ""
+    if platform not in ("tpu", "axon", "gpu"):
+        log(f"accelerator probe said {platform!r}; forcing CPU backend")
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    devs = jax.devices()
     on_tpu = devs[0].platform in ("tpu", "axon")
     log(f"bench devices: {devs}")
 
