@@ -88,8 +88,11 @@ class Context:
         self.my_rank = my_rank
         self.nb_ranks = nb_ranks
         self.pins = pins_mod.PinsManager()
+        from .vpmap import VPMap
+        self.vpmap = VPMap(nb_threads=self.nb_cores)
         self.streams: List[ExecutionStream] = [
-            ExecutionStream(i, self) for i in range(self.nb_cores)
+            ExecutionStream(i, self, vp_id=self.vpmap.thread_to_vp(i))
+            for i in range(self.nb_cores)
         ]
         self.sched = sched_mod.create(scheduler)
         self.sched.install(self)
@@ -176,11 +179,22 @@ class Context:
         return tp.completed
 
     def fini(self) -> None:
-        """parsec_fini: drain and join workers."""
+        """parsec_fini: drain and join workers; report statistics
+        (the per-thread usage + device statistics reports the reference
+        prints at shutdown, scheduling.c:47-90 / device.c)."""
         if self._finalized:
             return
         self.wait()
         self._finalized = True
+        for s in self.streams:
+            if s.nb_executed:
+                output.debug_verbose(1, "stats",
+                                     f"es{s.th_id} (vp{s.vp_id}): "
+                                     f"{s.nb_executed} tasks, "
+                                     f"{s.nb_selects} selects")
+        for name, st in self.devices.statistics().items():
+            if st["executed_tasks"]:
+                output.debug_verbose(1, "stats", f"device {name}: {st}")
         self._work_event.set()
         for t in self._workers:
             t.join(timeout=5.0)
@@ -211,6 +225,9 @@ class Context:
 
     # ------------------------------------------------------------------ hot loop
     def _worker_main(self, stream: ExecutionStream) -> None:
+        if mca.get("runtime_bind_threads", False):
+            from .vpmap import bind_current_thread
+            bind_current_thread(self.vpmap.core_of(stream.th_id))
         while not self._finalized:
             self._progress_loop(stream, until=lambda: self._active == 0)
             # park until new work shows up

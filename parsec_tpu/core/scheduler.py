@@ -168,14 +168,21 @@ class _LocalQueuesBase(SchedulerModule):
         t = q.pop() if self.use_priority else q.pop_front()
         if t is not None:
             return t, 0
-        # work stealing: scan other streams by increasing distance
-        # (ref: lfq steals through the hierarchy of bounded buffers)
+        # work stealing by increasing topological distance: same virtual
+        # process (NUMA-ish group) first, then the rest — the hierarchy the
+        # reference's lfq walks through its bounded buffers
         me = stream.th_id
         n = len(self._order)
         if n > 1:
+            my_vp = getattr(stream, "vp_id", 0)
+            ctx = getattr(self, "context", None)
             start = self._order.index(me) if me in self._order else 0
-            for d in range(1, n):
-                victim = self._queues[self._order[(start + d) % n]]
+            order = [self._order[(start + d) % n] for d in range(1, n)]
+            if ctx is not None:
+                order.sort(key=lambda tid: 0 if
+                           ctx.streams[tid].vp_id == my_vp else 1)
+            for d, tid in enumerate(order, start=1):
+                victim = self._queues[tid]
                 t = victim.pop() if self.use_priority else victim.pop_back()
                 if t is not None:
                     return t, d

@@ -93,6 +93,8 @@ class TPUDevice(DeviceModule):
         self._fifo_lock = threading.Lock()
         # LRU tile heap bookkeeping (ref: gpu_mem_lru / gpu_mem_owned_lru)
         self.batched_dispatches = 0
+        self._prof_stream = None
+        self._prof_keys = None
         self._lru: "collections.OrderedDict[Any, DataCopy]" = collections.OrderedDict()
         self._resident_bytes = 0
         budget = mca.get("device_tpu_max_bytes", 0)
@@ -220,8 +222,24 @@ class TPUDevice(DeviceModule):
         self._lru_touch(data.key, copy)
         return copy
 
+    def _prof(self):
+        """Per-device profiling stream (ref: per-GPU-stream profiling
+        streams, profiling.h:146-440), lazily bound to ctx.profiling."""
+        prof = getattr(self.context, "profiling", None)
+        if prof is None:
+            return None
+        if getattr(self, "_prof_stream", None) is None:
+            self._prof_stream = prof.stream(self.name)
+            self._prof_keys = prof.add_dictionary_keyword(f"{self.name}::exec")
+        return self._prof_stream
+
     def _submit_one(self, gt: TPUTask) -> None:
         task = gt.task
+        ps = self._prof()
+        if ps is not None:
+            from ..utils.trace import EVENT_FLAG_START
+            ps.trace(self._prof_keys[0], hash(task.key) & 0x7FFFFFFF,
+                     task.taskpool.taskpool_id, EVENT_FLAG_START)
         inputs = self._gather_inputs(gt)
         outs = gt.submit(self, task, inputs)
         if outs is None:
@@ -302,6 +320,11 @@ class TPUDevice(DeviceModule):
                     self._stage_out(data, copy)
             else:
                 slot.data_out = arr
+        ps = self._prof()
+        if ps is not None:
+            from ..utils.trace import EVENT_FLAG_END
+            ps.trace(self._prof_keys[1], hash(task.key) & 0x7FFFFFFF,
+                     task.taskpool.taskpool_id, EVENT_FLAG_END)
         self.executed_tasks += 1
         self.load_sub(gt.load)
         if gt.complete_cb is not None:

@@ -270,3 +270,39 @@ def test_info_registry():
     bag.set(b, {"x": 1})
     assert bag.get(b) == {"x": 1}
     assert bag.get(a, "none") == "none"
+
+
+def test_datatype_shim():
+    """Layout descriptors + pack/unpack (ref: parsec/datatype.h)."""
+    from parsec_tpu.data.datatype import (create_contiguous, create_resized,
+                                          create_vector, pack, unpack)
+    c = create_contiguous(10, "float32")
+    assert c.size == 40 and c.extent == 10
+    # a column of a 4x6 row-major matrix: 4 blocks of 1, stride 6
+    v = create_vector(4, 1, 6, "float32")
+    assert v.size == 16 and v.extent == 19
+    mat = np.arange(24, dtype=np.float32).reshape(4, 6)
+    col2 = pack(mat, create_resized(v, 2, 24))
+    np.testing.assert_array_equal(col2, mat[:, 2])
+    out = unpack(col2, create_resized(v, 2, 24)).reshape(4, 6)
+    np.testing.assert_array_equal(out[:, 2], mat[:, 2])
+    assert out[:, 0].sum() == 0
+
+
+def test_device_profiling_stream():
+    """Per-device profiling streams (ref: per-GPU-stream profiling)."""
+    from parsec_tpu.utils import mca as M
+    from parsec_tpu.utils.trace import Profiling
+    M.set("device_tpu_over_cpu", True)
+    try:
+        ctx = Context(nb_cores=1)
+        ctx.profiling = Profiling()
+        tp = DTDTaskpool(ctx, "devprof")
+        t = tp.tile_new((4, 4), np.float32)
+        for _ in range(3):
+            tp.insert_task(lambda x: x + 1.0, (t, RW))
+        tp.wait(); tp.close(); ctx.wait(); ctx.fini()
+        st = ctx.profiling.stats()
+        assert st["streams"] >= 1 and st["events"] >= 6  # 3 begin + 3 end
+    finally:
+        M.params.unset("device_tpu_over_cpu")
