@@ -110,6 +110,7 @@ class Context:
         self._finalized = False
         self._workers: List[threading.Thread] = []
         self._work_event = threading.Event()
+        self._error: Optional[BaseException] = None
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
 
@@ -240,6 +241,8 @@ class Context:
         deadline = None if timeout is None else time.monotonic() + timeout
         backoff_max = mca.get("runtime_backoff_max_us", 1000) / 1e6
         while not until():
+            if self._error is not None:
+                raise self._error
             did_something = False
             # master progresses communications inline (ref: scheduling.c:790-798)
             if stream.is_master and self.comm is not None:
@@ -256,7 +259,18 @@ class Context:
                 stream.nb_selects += 1
             if task is not None:
                 misses = 0
-                self._task_progress(stream, task, distance)
+                try:
+                    self._task_progress(stream, task, distance)
+                except BaseException as e:  # noqa: BLE001
+                    # a failing body must surface to every waiter, not die
+                    # silently with one worker thread (ref: hook errors are
+                    # fatal, scheduling.c:541-548)
+                    if self._error is None:
+                        self._error = e
+                    self._work_event.set()
+                    if stream.is_master:
+                        raise
+                    return
                 did_something = True
             if not did_something:
                 misses += 1

@@ -213,3 +213,35 @@ def test_taskpool_wait_two_pools(context):
     assert tps[0].wait(timeout=10)
     context.wait()
     assert all(tp.completed for tp in tps)
+
+
+def test_body_exception_propagates():
+    """A raising task body surfaces from wait() instead of deadlocking
+    (workers record the error; the master re-raises)."""
+    ctx = Context(nb_cores=2)
+    from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+    import numpy as np
+    tp = DTDTaskpool(ctx, "boom")
+    t = tp.tile_new((2, 2), np.float32)
+
+    def bad(x):
+        raise ValueError("intentional body failure")
+
+    tp.insert_task(bad, (t, RW), jit=False)
+    with pytest.raises((ValueError, RuntimeError)):
+        tp.wait(timeout=10)
+        tp.close()
+        ctx.wait(timeout=10)
+    ctx._error = None   # allow clean fixture teardown
+    ctx._finalized = True
+
+
+def test_cli_help_mca():
+    import subprocess, sys, os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-m", "parsec_tpu", "--help-mca"],
+                         capture_output=True, text=True, timeout=110,
+                         cwd=root, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0
+    assert "--mca sched" in out.stdout
+    assert "dtd_window_size" in out.stdout
