@@ -182,10 +182,13 @@ class Context:
     def fini(self) -> None:
         """parsec_fini: drain and join workers; report statistics
         (the per-thread usage + device statistics reports the reference
-        prints at shutdown, scheduling.c:47-90 / device.c)."""
+        prints at shutdown, scheduling.c:47-90 / device.c). After a body
+        error the context is poisoned: fini skips the drain and tears down
+        cleanly instead of re-raising."""
         if self._finalized:
             return
-        self.wait()
+        if self._error is None:
+            self.wait()
         self._finalized = True
         for s in self.streams:
             if s.nb_executed:
@@ -242,7 +245,9 @@ class Context:
         backoff_max = mca.get("runtime_backoff_max_us", 1000) / 1e6
         while not until():
             if self._error is not None:
-                raise self._error
+                if stream.is_master:
+                    raise self._error
+                return  # workers park quietly; the master surfaces the error
             did_something = False
             # master progresses communications inline (ref: scheduling.c:790-798)
             if stream.is_master and self.comm is not None:
@@ -327,7 +332,11 @@ class Context:
                 self.complete_task_execution(stream, task)
                 return rc
             if rc == HOOK_ASYNC:
-                # completion arrives via complete_task_execution from a device
+                # completion arrives via complete_task_execution from a
+                # device; the EXEC interval closes here (it measures host
+                # dispatch — device execution shows on the device's own
+                # profiling stream)
+                self.pins.fire(pins_mod.EXEC_END, stream, task)
                 return rc
             if rc == HOOK_AGAIN:
                 self.pins.fire(pins_mod.EXEC_END, stream, task)

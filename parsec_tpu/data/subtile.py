@@ -49,7 +49,7 @@ class SubtileCollection(TiledMatrix):
     def flush(self) -> None:
         """Write the subtile results back into the parent tile (new buffer:
         the parent's version advances like any task write)."""
-        out = np.array(self._buffer, copy=False)
+        out = np.array(self._buffer, copy=True)
         for m in range(self.mt):
             for n in range(self.nt):
                 d = self._datas.get(self.data_key(m, n))

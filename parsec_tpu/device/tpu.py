@@ -56,7 +56,7 @@ class TPUTask:
 
     __slots__ = ("task", "submit", "stage_in", "stage_out", "pushout",
                  "batchable", "batch_submit", "load", "out_arrays",
-                 "complete_cb")
+                 "complete_cb", "oom_retries")
 
     def __init__(self, task: Task, submit: Callable, stage_in=None,
                  stage_out=None, pushout: int = 0, batchable: bool = False,
@@ -74,6 +74,7 @@ class TPUTask:
         self.load = 0.0
         self.out_arrays: Optional[Sequence[Any]] = None
         self.complete_cb: Optional[Callable] = None
+        self.oom_retries = 0
 
 
 class TPUDevice(DeviceModule):
@@ -157,31 +158,13 @@ class TPUDevice(DeviceModule):
                                self._pending[0].batch_submit == gt.batch_submit and
                                self._pending[0].task.task_class is gt.task.task_class):
                             group.append(self._pending.popleft())
-                try:
-                    if len(group) > 1:
-                        self._submit_group(group)
+                if len(group) > 1:
+                    submitted = self._submit_group(group)
+                    if len(submitted) == len(group):
                         self.batched_dispatches += 1
-                    else:
-                        self._submit_one(gt)
-                except Exception as e:
-                    if _is_oom(e):
-                        # out of HBM: evict and retry; if still starved,
-                        # bounce the tasks back to the scheduler (the
-                        # OOM -> HOOK_AGAIN discipline of device_gpu.c)
-                        self.evict_bytes(max(self._resident_bytes // 2, 1))
-                        try:
-                            for g in group:
-                                self._submit_one(g)
-                        except Exception:
-                            for g in group:
-                                self.load_sub(g.load)
-                                self.context.schedule([g.task])
-                            continue
-                    else:
-                        for g in group:
-                            self.load_sub(g.load)
-                        output.fatal(f"TPU submit failed for {gt.task!r}: {e}")
-                self._inflight.extend(group)
+                else:
+                    submitted = group if self._submit_one_retry(gt) else []
+                self._inflight.extend(submitted)
             # event polling + kernel_pop/epilog (device_gpu.c:2593,2944,3179)
             while self._inflight:
                 gt = self._inflight[0]
@@ -269,10 +252,39 @@ class TPUDevice(DeviceModule):
                 inputs.append(self._jax.device_put(copy_in.payload, self.jax_device))
         return inputs
 
-    def _submit_group(self, group: List[TPUTask]) -> None:
+    def _submit_one_retry(self, gt: TPUTask) -> bool:
+        """Submit with the OOM -> evict -> retry -> HOOK_AGAIN discipline of
+        device_gpu.c. Returns True when dispatched; False when the task was
+        bounced back to the scheduler."""
+        try:
+            self._submit_one(gt)
+            return True
+        except Exception as e:  # noqa: BLE001
+            if not _is_oom(e):
+                self.load_sub(gt.load)
+                output.fatal(f"TPU submit failed for {gt.task!r}: {e}")
+            freed = self.evict_bytes(max(self._resident_bytes // 2, 1))
+            try:
+                self._submit_one(gt)
+                return True
+            except Exception as e2:  # noqa: BLE001
+                if not _is_oom(e2):
+                    self.load_sub(gt.load)
+                    output.fatal(f"TPU submit failed for {gt.task!r}: {e2}")
+                gt.oom_retries += 1
+                if freed == 0 or gt.oom_retries > 8:
+                    output.fatal(
+                        f"task {gt.task!r} does not fit in device memory "
+                        f"(resident={self._resident_bytes}, "
+                        f"retries={gt.oom_retries})")
+                self.load_sub(gt.load)
+                self.context.schedule([gt.task])
+                return False
+
+    def _submit_group(self, group: List[TPUTask]) -> List[TPUTask]:
         """One dispatch for a batch of compatible independent tasks; ragged
         batches (e.g. boundary tiles of a different shape) fall back to
-        per-task submission instead of failing the run."""
+        per-task submission. Returns the tasks actually dispatched."""
         inputs_list = [self._gather_inputs(g) for g in group]
         try:
             outs_list = group[0].batch_submit(self, [g.task for g in group],
@@ -280,15 +292,14 @@ class TPUDevice(DeviceModule):
         except Exception as e:  # noqa: BLE001 - ragged shapes etc.
             output.debug_verbose(2, "device",
                                  f"batch of {len(group)} fell back: {e}")
-            for g in group:
-                self._submit_one(g)
-            return
+            return [g for g in group if self._submit_one_retry(g)]
         for g, outs in zip(group, outs_list):
             if outs is None:
                 outs = ()
             elif not isinstance(outs, (tuple, list)):
                 outs = (outs,)
             g.out_arrays = tuple(outs)
+        return group
 
     def _epilog(self, stream, gt: TPUTask) -> None:
         """parsec_device_kernel_epilog (device_gpu.c:3179): attach outputs,
@@ -357,8 +368,7 @@ class TPUDevice(DeviceModule):
         freed0 = self._resident_bytes
         while self._resident_bytes > target and self._lru:
             before = self._resident_bytes
-            self._reserve(self._budget)  # no-op unless over budget
-            # direct eviction of the LRU head
+            # evict the least-recently-used unpinned copy
             for key in list(self._lru):
                 copy = self._lru[key]
                 if copy.readers > 0:
