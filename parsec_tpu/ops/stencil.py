@@ -121,3 +121,91 @@ def reference_stencil1d(dense: np.ndarray, iterations: int,
         xp = np.concatenate([x[..., 1:], np.zeros_like(x[..., :1])], axis=-1)
         x = w0 * xm + w1 * x + w2 * xp
     return x
+
+
+# ---------------------------------------------------------------------------
+# 2D stencil (5-point) — BASELINE config 4's 2D variant
+# ---------------------------------------------------------------------------
+
+def stencil2d_body(x, up, down, left, right, w=(0.2, 0.2, 0.2, 0.2, 0.2)):
+    """One Jacobi step of the 5-point stencil on an (mb, nb) tile with halo
+    rows/columns from the four neighbor tiles (zeros at the boundary)."""
+    import jax.numpy as jnp
+    wc, wu, wd, wl, wr = w
+    urow = up[-1:, :] if up is not None else jnp.zeros_like(x[:1, :])
+    drow = down[:1, :] if down is not None else jnp.zeros_like(x[:1, :])
+    lcol = left[:, -1:] if left is not None else jnp.zeros_like(x[:, :1])
+    rcol = right[:, :1] if right is not None else jnp.zeros_like(x[:, :1])
+    xu = jnp.concatenate([urow, x[:-1, :]], axis=0)
+    xd = jnp.concatenate([x[1:, :], drow], axis=0)
+    xl = jnp.concatenate([lcol, x[:, :-1]], axis=1)
+    xr = jnp.concatenate([x[:, 1:], rcol], axis=1)
+    return wc * x + wu * xu + wd * xd + wl * xl + wr * xr
+
+
+_BODIES2D = {}
+
+
+def _body2d_for(has, w):
+    key = (has, w)
+    b = _BODIES2D.get(key)
+    if b is not None:
+        return b
+    hu, hd, hl, hr = has
+
+    def body(x, *halos):
+        i = 0
+        up = halos[i] if hu else None
+        i += hu
+        down = halos[i] if hd else None
+        i += hd
+        left = halos[i] if hl else None
+        i += hl
+        right = halos[i] if hr else None
+        return stencil2d_body(x, up, down, left, right, w)
+
+    wrapped = _StencilTask(body)
+    _BODIES2D[key] = wrapped
+    return wrapped
+
+
+def insert_stencil2d_tasks(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,
+                           iterations: int,
+                           weights=(0.2, 0.2, 0.2, 0.2, 0.2)) -> int:
+    """Jacobi 5-point stencil, A <-> B double buffering. The four halo reads
+    become remote deps across an owner grid in distributed runs."""
+    assert (A.mt, A.nt) == (B.mt, B.nt)
+    n0 = tp.inserted
+    src, dst = A, B
+    for _ in range(iterations):
+        for mi in range(src.mt):
+            for ni in range(src.nt):
+                has = (mi > 0, mi < src.mt - 1, ni > 0, ni < src.nt - 1)
+                args = [(tp.tile_of(dst, mi, ni), RW | AFFINITY),
+                        (tp.tile_of(src, mi, ni), READ)]
+                if has[0]:
+                    args.append((tp.tile_of(src, mi - 1, ni), READ))
+                if has[1]:
+                    args.append((tp.tile_of(src, mi + 1, ni), READ))
+                if has[2]:
+                    args.append((tp.tile_of(src, mi, ni - 1), READ))
+                if has[3]:
+                    args.append((tp.tile_of(src, mi, ni + 1), READ))
+                tp.insert_task(_body2d_for(has, tuple(weights)), *args,
+                               name="ST2D")
+        src, dst = dst, src
+    return tp.inserted - n0
+
+
+def reference_stencil2d(dense: np.ndarray, iterations: int,
+                        weights=(0.2, 0.2, 0.2, 0.2, 0.2)) -> np.ndarray:
+    wc, wu, wd, wl, wr = weights
+    x = dense.astype(np.float64)
+    for _ in range(iterations):
+        z = np.zeros_like(x)
+        xu = np.concatenate([z[:1, :], x[:-1, :]], axis=0)
+        xd = np.concatenate([x[1:, :], z[:1, :]], axis=0)
+        xl = np.concatenate([z[:, :1], x[:, :-1]], axis=1)
+        xr = np.concatenate([x[:, 1:], z[:, :1]], axis=1)
+        x = wc * x + wu * xu + wd * xd + wl * xl + wr * xr
+    return x

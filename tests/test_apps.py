@@ -164,3 +164,61 @@ def test_sched_bench_runs():
     lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
     assert {l["sched"] for l in lines} == {"lfq", "ap"}
     assert all(l["value"] > 0 for l in lines)
+
+
+def test_stencil2d(ctx):
+    """5-point 2D stencil (BASELINE config 4's 2D variant)."""
+    from parsec_tpu.ops.stencil import (insert_stencil2d_tasks,
+                                        reference_stencil2d)
+    MT, TS, ITERS = 3, 8, 4
+    rng = np.random.default_rng(70)
+    dense = rng.standard_normal((MT * TS, MT * TS)).astype(np.float32)
+    A = TiledMatrix("S2A", MT*TS, MT*TS, TS, TS)
+    B = TiledMatrix("S2B", MT*TS, MT*TS, TS, TS)
+    A.fill(lambda m, n: dense[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
+    B.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+    tp = DTDTaskpool(ctx, "st2d")
+    ntasks = insert_stencil2d_tasks(tp, A, B, ITERS)
+    assert ntasks == MT * MT * ITERS
+    tp.wait(); tp.close(); ctx.wait()
+    out = (B if ITERS % 2 else A).to_dense()
+    np.testing.assert_allclose(out, reference_stencil2d(dense, ITERS),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_stencil2d_distributed():
+    """2D halo exchange across a 2x2 rank grid."""
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+    from parsec_tpu.ops.stencil import (insert_stencil2d_tasks,
+                                        reference_stencil2d)
+
+    MT, TS, ITERS = 4, 8, 3
+    rng = np.random.default_rng(71)
+    dense = rng.standard_normal((MT * TS, MT * TS)).astype(np.float32)
+
+    def program(rank, fabric):
+        c = Context(nb_cores=1, my_rank=rank, nb_ranks=4)
+        RemoteDepEngine(c, ThreadsCE(fabric, rank))
+        kw = dict(nodes=4, myrank=rank, P=2, Q=2)
+        A = TwoDimBlockCyclic("D2A", MT*TS, MT*TS, TS, TS, **kw)
+        B = TwoDimBlockCyclic("D2B", MT*TS, MT*TS, TS, TS, **kw)
+        A.fill(lambda m, n: dense[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
+        B.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        tp = DTDTaskpool(c, "dst2d")
+        insert_stencil2d_tasks(tp, A, B, ITERS)
+        tp.wait(timeout=60); tp.close(); c.wait(timeout=60); c.fini()
+        out = B if ITERS % 2 else A
+        return {(m, n): np.asarray(out.data_of(m, n).newest_copy().payload)
+                for m in range(MT) for n in range(MT)
+                if out.rank_of(m, n) == rank}
+
+    results = run_distributed(4, program, timeout=180)
+    ref = reference_stencil2d(dense, ITERS)
+    full = {}
+    for o in results:
+        full.update(o)
+    assert len(full) == MT * MT
+    for (m, n), tile in full.items():
+        np.testing.assert_allclose(tile, ref[m*TS:(m+1)*TS, n*TS:(n+1)*TS],
+                                   rtol=1e-4, atol=1e-4)

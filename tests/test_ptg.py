@@ -326,6 +326,61 @@ END
 """,
     "too_many_flows": "%global A\nT(k)\n  k = 0 .. 3\n" + "".join(
         f"  READ F{i} <- A(k)\n" for i in range(20)) + "BODY\n  pass\nEND\n",
+    "duplicate_class": """
+%global A
+T(k)
+  k = 0 .. 3
+  RW X <- A(k)
+BODY
+  X = X
+END
+
+T(m)
+  m = 0 .. 3
+  RW X <- A(m)
+BODY
+  X = X
+END
+""",
+    "unknown_body_device": """
+%global A
+T(k)
+  k = 0 .. 3
+  RW X <- A(k)
+BODY [type=FPGA]
+  X = X
+END
+""",
+    "body_without_end": """
+%global A
+T(k)
+  k = 0 .. 3
+  RW X <- A(k)
+BODY
+  X = X
+""",
+    "dep_outside_flow": """
+%global A
+T(k)
+  k = 0 .. 3
+  <- A(k)
+BODY
+  pass
+END
+""",
+    "garbage_line": """
+%global A
+T(k)
+  k = 0 .. 3
+  this is not a valid construct !!!
+  RW X <- A(k)
+BODY
+  X = X
+END
+""",
+    "no_task_classes": """
+%global A
+""",
 }
 
 
