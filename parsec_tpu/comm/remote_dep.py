@@ -152,18 +152,21 @@ class RemoteDepEngine:
                 return
         task.pending_inputs[flow_index] = payload
 
-    def note_send(self, tp, tile, version: int, dst_rank: int) -> None:
-        """A remote task on ``dst_rank`` will need (tile, version) that this
-        rank produces (or already holds)."""
+    def note_send(self, tp, tile, version: int, dst_rank: int,
+                  writer=None) -> None:
+        """A remote task on ``dst_rank`` will need (tile, version).
+
+        ``writer`` is the local task producing that version (captured by the
+        caller BEFORE any same-call chain mutation); a pending writer gets
+        the send attached (rank_sent_to bitmap), a finished/absent writer
+        means the payload is already the tile's newest local content."""
         self.register_tile(tile)
         with self._lock:
             if (tile.key, version, dst_rank) in self._sent:
                 return
-        writer = tile.last_writer
         if writer is not None and not writer.completed and \
-                writer.rank == self.ce.my_rank and \
-                tile.last_writer_version == version:
-            # attach to the pending local writer (rank_sent_to bitmap)
+                writer.rank == self.ce.my_rank:
+            # attach to the pending local producer of ``version``
             writer.remote_sends.setdefault(id(tile), (tile, version, set()))
             writer.remote_sends[id(tile)][2].add(dst_rank)
             return
@@ -175,14 +178,26 @@ class RemoteDepEngine:
 
     def dtd_task_completed(self, tp, task) -> None:
         """Local writer finished: fire queued remote sends (the remote
-        activation fork of parsec_release_dep_fct)."""
+        activation fork of parsec_release_dep_fct). The payload is this
+        task's OWN output for the tile (a later local writer may already
+        have advanced the tile's newest copy)."""
         sends = getattr(task, "remote_sends", None)
         if not sends:
             return
         for tile, version, ranks in list(sends.values()):
-            copy = tile.data.newest_copy()
-            payload = np.asarray(copy.payload)
-            self.send_data(tp, tile, version, sorted(ranks), payload)
+            payload = None
+            for i, t in enumerate(getattr(task, "tiles", [])):
+                if t is tile:
+                    slot = task.data[i]
+                    out = slot.data_out if slot.data_out is not None else slot.data_in
+                    if out is not None:
+                        payload = out.payload if hasattr(out, "payload") else out
+                    break
+            if payload is None:
+                copy = tile.data.newest_copy()
+                payload = copy.payload
+            self.send_data(tp, tile, version, sorted(ranks),
+                           np.asarray(payload))
         sends.clear()
 
     def dtd_remote_task(self, tp, task) -> None:

@@ -345,6 +345,10 @@ class DTDTaskpool(Taskpool):
         with tile.lock:
             read_version = tile.wcount
             src_rank = tile.writer_rank
+            # the producer of read_version — captured BEFORE the write side
+            # below replaces last_writer (the consumer must attach its send
+            # to the task that PRODUCES the version it reads, not to itself)
+            prev_writer = tile.last_writer
             if acc & READ or not (acc & WRITE):
                 # RAW: predecessor is the last writer (local chain) or a
                 # remote version expectation / outbound send
@@ -379,7 +383,8 @@ class DTDTaskpool(Taskpool):
                             flow_index)
             elif remote and needs_data and src_rank == my:
                 # remote consumer of a locally-held/produced version
-                comm.note_send(self, tile, read_version, task.rank)
+                comm.note_send(self, tile, read_version, task.rank,
+                               writer=prev_writer)
         if remote:
             return
         seen = set()

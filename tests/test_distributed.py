@@ -290,3 +290,95 @@ def test_distributed_ptg_gemm(nb_ranks):
     for (m, n), tile in full.items():
         np.testing.assert_allclose(tile, ref[m*TS:(m+1)*TS, n*TS:(n+1)*TS],
                                    rtol=1e-3, atol=1e-3)
+
+
+def _bump_anchor(x, anchor):
+    return x + 1.0
+
+
+def test_alternating_rank_write_chain():
+    """A single tile written by a chain of tasks that alternates ranks:
+    each hop ships the PRODUCER's output, not whatever the tile held at
+    insertion time (regression: note_send once consulted the freshly
+    overwritten last_writer and shipped stale payloads)."""
+    def program(rank, fabric):
+        ctx = _mkctx(rank, fabric)
+        A = TwoDimBlockCyclic("ALT", 16, 4, 4, 4, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        A.fill(lambda m, n: np.zeros((4, 4), np.float32))
+        tp = DTDTaskpool(ctx, "altchain")
+        t = tp.tile_of(A, 0, 0)
+        anchors = [tp.tile_of(A, 2, 0), tp.tile_of(A, 1, 0)]  # rank0, rank1
+        N = 8
+        for i in range(N):
+            tp.insert_task(_bump_anchor, (t, RW),
+                           (anchors[i % 2], READ | AFFINITY),
+                           jit=False, name="bump")
+        tp.data_flush_all(A)
+        tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30); ctx.fini()
+        if rank == 0:
+            return float(np.asarray(A.data_of(0, 0).newest_copy().payload)[0, 0])
+        return None
+
+    results = run_distributed(2, program, timeout=60)
+    assert results[0] == 8.0
+
+
+def test_distributed_geqrf_row_cyclic():
+    """Tile QR across 2 ranks with ROW-cyclic tiles: TSQRT/TSMQR write
+    tiles owned by other ranks (flush writes them home) and Q factors ship
+    across the fabric — BASELINE config 5's dgeqrf shape."""
+    from parsec_tpu.ops.geqrf import insert_geqrf_tasks
+    n, ts = 64, 16
+    rng = np.random.default_rng(92)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+
+    def program(rank, fabric):
+        ctx = _mkctx(rank, fabric)
+        A = TwoDimBlockCyclic("QRD", n, n, ts, ts, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        A.fill(lambda m, k: a[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+        tp = DTDTaskpool(ctx, "dgeqrf")
+        insert_geqrf_tasks(tp, A)
+        tp.data_flush_all(A)
+        tp.wait(timeout=60); tp.close(); ctx.wait(timeout=60); ctx.fini()
+        return {(m, k): np.asarray(A.data_of(m, k).newest_copy().payload)
+                for m in range(n//ts) for k in range(n//ts)
+                if A.rank_of(m, k) == rank}
+
+    results = run_distributed(2, program, timeout=180)
+    M = np.zeros((n, n), np.float32)
+    for o in results:
+        for (m, k), tile in o.items():
+            M[m*ts:(m+1)*ts, k*ts:(k+1)*ts] = tile
+    R = np.triu(M)
+    ref = a.T @ a
+    np.testing.assert_allclose(R.T @ R, ref,
+                               atol=0.05 * np.abs(ref).max())
+
+
+def test_distributed_getrf():
+    """Tiled LU (no pivoting) across 2 ranks."""
+    from parsec_tpu.ops.getrf import insert_getrf_tasks, make_dd, unpack_lu
+    n, ts = 64, 16
+    a = make_dd(n, seed=93)
+
+    def program(rank, fabric):
+        ctx = _mkctx(rank, fabric)
+        A = TwoDimBlockCyclic("LUD", n, n, ts, ts, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        A.fill(lambda m, k: a[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+        tp = DTDTaskpool(ctx, "dgetrf")
+        insert_getrf_tasks(tp, A)
+        tp.wait(timeout=60); tp.close(); ctx.wait(timeout=60); ctx.fini()
+        return {(m, k): np.asarray(A.data_of(m, k).newest_copy().payload)
+                for m in range(n//ts) for k in range(n//ts)
+                if A.rank_of(m, k) == rank}
+
+    results = run_distributed(2, program, timeout=180)
+    M = np.zeros((n, n), np.float32)
+    for o in results:
+        for (m, k), tile in o.items():
+            M[m*ts:(m+1)*ts, k*ts:(k+1)*ts] = tile
+    L, U = unpack_lu(M)
+    np.testing.assert_allclose(L @ U, a, rtol=2e-2, atol=2e-2)
