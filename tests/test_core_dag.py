@@ -244,3 +244,49 @@ def test_cli_help_mca():
     assert out.returncode == 0
     assert "--mca sched" in out.stdout
     assert "dtd_window_size" in out.stdout
+
+
+def test_dtd_and_ptg_concurrently():
+    """Both frontends share one context and run interleaved."""
+    import numpy as np
+    from parsec_tpu.data.matrix import TiledMatrix
+    from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+
+    ctx = Context(nb_cores=1)
+    A = TiledMatrix("mixA", 4, 4, 4, 4)
+    A.fill(lambda m, n: np.zeros((4, 4), np.float32))
+    ptg = compile_ptg("""
+%global NT
+%global A
+T(k)
+  k = 0 .. NT-1
+  : A(0, 0)
+  RW X <- (k == 0) ? A(0, 0) : X T(k-1)
+     -> (k < NT-1) ? X T(k+1) : A(0, 0)
+BODY
+  X = X + 1.0
+END
+""", "mixptg").instantiate(ctx, globals={"NT": 5}, collections={"A": A})
+    ctx.add_taskpool(ptg)
+    dtp = DTDTaskpool(ctx, "mixdtd")
+    t = dtp.tile_new((2, 2), np.float32)
+    for _ in range(5):
+        dtp.insert_task(lambda x: x + 2.0, (t, RW))
+    dtp.wait(); dtp.close()
+    ctx.wait()
+    ctx.fini()
+    assert ptg.completed and dtp.completed
+    assert np.allclose(A.to_dense(), 5.0)
+    assert np.allclose(np.asarray(t.data.newest_copy().payload), 10.0)
+
+
+def test_context_argv_mca():
+    """parsec_init-style cmdline: --mca pairs consumed at context creation."""
+    from parsec_tpu.utils import mca
+    ctx = Context(nb_cores=1, argv=["prog", "--mca", "sched", "ap", "x"])
+    try:
+        assert ctx.sched.name == "ap"
+    finally:
+        ctx.fini()
+        mca.params._params["sched"].has_cmdline = False  # restore default
