@@ -164,12 +164,16 @@ class RemoteDepEngine:
         with self._lock:
             if (tile.key, version, dst_rank) in self._sent:
                 return
-        if writer is not None and not writer.completed and \
-                writer.rank == self.ce.my_rank:
-            # attach to the pending local producer of ``version``
-            writer.remote_sends.setdefault(id(tile), (tile, version, set()))
-            writer.remote_sends[id(tile)][2].add(dst_rank)
-            return
+        if writer is not None and writer.rank == self.ce.my_rank:
+            # attach under the writer's lock and re-check completed there:
+            # completion sets the flag and drains remote_sends under the
+            # same lock, so an attach can never be lost in between
+            with writer.lock:
+                if not writer.completed:
+                    writer.remote_sends.setdefault(id(tile),
+                                                   (tile, version, set()))
+                    writer.remote_sends[id(tile)][2].add(dst_rank)
+                    return
         # data already available locally: send right away
         copy = tile.data.newest_copy()
         if copy is None:
@@ -182,12 +186,19 @@ class RemoteDepEngine:
         task's OWN output for the tile (a later local writer may already
         have advanced the tile's newest copy)."""
         sends = getattr(task, "remote_sends", None)
-        if not sends:
+        if sends is None:
             return
-        for tile, version, ranks in list(sends.values()):
+        with task.lock:   # excludes concurrent note_send attaches
+            entries = list(sends.values())
+            sends.clear()
+        accesses = getattr(task.task_class, "flow_accesses", ())
+        for tile, version, ranks in entries:
             payload = None
             for i, t in enumerate(getattr(task, "tiles", [])):
-                if t is tile:
+                # only a WRITE flow's slot holds the produced version (the
+                # same tile may also appear as a READ flow holding the old
+                # copy)
+                if t is tile and i < len(accesses) and (accesses[i] & 0x2):
                     slot = task.data[i]
                     out = slot.data_out if slot.data_out is not None else slot.data_in
                     if out is not None:
@@ -198,7 +209,6 @@ class RemoteDepEngine:
                 payload = copy.payload
             self.send_data(tp, tile, version, sorted(ranks),
                            np.asarray(payload))
-        sends.clear()
 
     def dtd_remote_task(self, tp, task) -> None:
         """Shadow of a task executing elsewhere — nothing to run locally;

@@ -551,11 +551,13 @@ class DTDTaskpool(Taskpool):
             task.completed = True
             succs = task.successors
             task.successors = []
+        # ship remote sends FIRST: the payload references must be captured
+        # before any released successor can rebind the tile's host copy
+        if self.ctx.comm is not None:
+            self.ctx.comm.dtd_task_completed(self, task)
         ready = [s for s in succs if s.dep_satisfied()]
         if ready:
             self.ctx.schedule(ready, stream)
-        if self.ctx.comm is not None:
-            self.ctx.comm.dtd_task_completed(self, task)
 
     # ------------------------------------------------------------- flush/wait
     def data_flush(self, tile: DTDTile) -> None:
