@@ -90,6 +90,15 @@ class RemoteDepEngine:
         self._tiles: Dict[Any, Any] = {}          # tile_key -> DTDTile
         self._sent: Set[Tuple] = set()            # (key, version, dst) dedup
         self._taskpools: Dict[str, Any] = {}      # name -> taskpool
+        # AMs that arrived before their taskpool registered locally: parked
+        # per taskpool name and replayed at registration (the data analogue
+        # of requeue_token — dropping them would desync fourcounter sent/recv
+        # and starve downstream multicast-tree ranks)
+        self._early_ams: Dict[str, List[Tuple]] = {}
+        # tile keys touched on behalf of each taskpool, so termination can
+        # garbage-collect _received/_sent/_applied_version (unbounded
+        # otherwise in long-running jobs)
+        self._tp_keys: Dict[str, Set[Any]] = {}
         self.fourcounter = termdet_mod.FourCounterTermdet(self)
         self._td_state: Dict[str, Dict[str, Any]] = {}
         self._enabled = False
@@ -123,11 +132,36 @@ class RemoteDepEngine:
             self._comm_thread.join(timeout=2.0)
 
     def register_taskpool(self, tp) -> None:
-        self._taskpools[tp.name] = tp
-        self._td_state.setdefault(tp.name, {
-            "wave": 0, "token_out": False, "held": None,
-            "last": None, "terminated": False,
-        })
+        # publish under _lock: AM handlers park-or-dispatch under the same
+        # lock, so an activate can never fall between "not registered yet"
+        # and "early list already drained"
+        with self._lock:
+            prev = self._taskpools.get(tp.name)
+            if prev is not None and prev is not tp:
+                st = self._td_state.get(tp.name)
+                if st is not None and st.get("terminated"):
+                    # a terminated pool never unregisters itself — recycle
+                    # its slot (same program run again in one process)
+                    self._td_state.pop(tp.name, None)
+                else:
+                    output.fatal(
+                        f"taskpool name collision: {tp.name!r} already "
+                        f"registered and live; concurrently-live distributed "
+                        f"taskpools must have unique names (DTDTaskpool "
+                        f"assigns a per-rank sequence number — construct "
+                        f"pools in the same order on every rank)")
+            self._taskpools[tp.name] = tp
+            self._td_state.setdefault(tp.name, {
+                "wave": 0, "token_out": False, "held": None,
+                "last": None, "terminated": False,
+            })
+            early = self._early_ams.pop(tp.name, [])
+        # replay AMs that raced ahead of this registration
+        for kind, src, hdr, payload in early:
+            if kind == "put":
+                self._on_put(self.ce, src, hdr, payload)
+            else:
+                self._on_activate(self.ce, src, hdr, payload)
 
     # ------------------------------------------------------------ DTD API
     def register_tile(self, tile) -> None:
@@ -144,6 +178,7 @@ class RemoteDepEngine:
         self.register_tile(tile)
         key = (tile.key, version)
         with self._lock:
+            self._tp_keys.setdefault(tp.name, set()).add(tile.key)
             payload = self._received.get(key)
             if payload is None:
                 with task.lock:
@@ -255,6 +290,8 @@ class RemoteDepEngine:
         if not ranks:
             return
         with self._lock:
+            if tp is not None:
+                self._tp_keys.setdefault(tp.name, set()).add(tile.key)
             ranks = [r for r in ranks
                      if (tile.key, version, r) not in self._sent]
             for r in ranks:
@@ -289,7 +326,19 @@ class RemoteDepEngine:
 
     # ------------------------------------------------------------ AM handlers
     def _on_activate(self, ce, src, hdr, payload) -> None:
-        tp = self._taskpools.get(hdr.get("tp"))
+        name = hdr.get("tp")
+        tp = self._taskpools.get(name)
+        if tp is None and name is not None:
+            # activate raced ahead of local taskpool registration: park it
+            # (counting it now would be lost; forwarding needs the taskpool).
+            # Re-check under _lock — registration publishes there, so either
+            # we see the pool or our parked AM is visible to its replay.
+            with self._lock:
+                tp = self._taskpools.get(name)
+                if tp is None:
+                    self._early_ams.setdefault(name, []).append(
+                        ("activate", src, hdr, payload))
+                    return
         if tp is not None:
             self.fourcounter.message_received(tp)
         if hdr.get("ptg"):
@@ -311,7 +360,15 @@ class RemoteDepEngine:
 
     def _on_put(self, ce, src, hdr, payload) -> None:
         origin = hdr.get("origin") or {}
-        tp = self._taskpools.get(origin.get("tp"))
+        name = origin.get("tp")
+        tp = self._taskpools.get(name)
+        if tp is None and name is not None:
+            with self._lock:
+                tp = self._taskpools.get(name)
+                if tp is None:
+                    self._early_ams.setdefault(name, []).append(
+                        ("put", src, hdr, payload))
+                    return
         self._data_arrived(tp, origin, payload, src)
 
     def _data_arrived(self, tp, hdr, payload, src) -> None:
@@ -331,6 +388,8 @@ class RemoteDepEngine:
                                    np.asarray(payload)))
         waiters: List[Tuple] = []
         with self._lock:
+            if hdr.get("tp") is not None:
+                self._tp_keys.setdefault(hdr["tp"], set()).add(key)
             self._received[(key, version)] = payload
             waiters = self._expected.pop((key, version), [])
             applied = self._applied_version.get(key, -1)
@@ -460,6 +519,7 @@ class RemoteDepEngine:
                 if nxt != 0:
                     ce.send_am(TAG_TERMDET, nxt, token, None)
                 self.fourcounter.declare_terminated(tp)
+                self._gc_taskpool(name)
             return
         if tp is None or st is None:
             # taskpool not registered yet: park the token until it is
@@ -482,5 +542,31 @@ class RemoteDepEngine:
                 self.ce.send_am(TAG_TERMDET, 1,
                                 {"type": "terminate", "tp": tp.name}, None)
             self.fourcounter.declare_terminated(tp)
+            self._gc_taskpool(tp.name)
             return
         st["last"] = (token["sent"], token["recv"]) if consistent else None
+
+    def _gc_taskpool(self, name: str) -> None:
+        """Drop per-payload bookkeeping for a terminated taskpool: every
+        reader has run, so parked payloads / send-dedup / applied-version
+        entries for its tiles can never be consumed again."""
+        with self._lock:
+            keys = self._tp_keys.pop(name, set())
+            # a tile key shared with a still-live pool stays accounted to it
+            # (remaining _tp_keys entries all belong to live pools)
+            for other in self._tp_keys.values():
+                keys -= other
+                if not keys:
+                    break
+            for k in keys:
+                self._applied_version.pop(k, None)
+                self._tiles.pop(k, None)
+            if keys:
+                self._received = {kv: p for kv, p in self._received.items()
+                                  if kv[0] not in keys}
+            # tile-key entries + PTG send-dedup entries (which embed the
+            # taskpool name in the key) in one pass
+            self._sent = {s for s in self._sent
+                          if s[0] not in keys
+                          and not (isinstance(s[0], tuple) and len(s[0]) == 5
+                                   and s[0][0] == "ptg" and s[0][1] == name)}

@@ -481,7 +481,14 @@ class Context:
                 slot.source_repo_entry._repo.entry_used_once(slot.source_repo_entry.key)
         if ready:
             ready.sort(key=lambda t: -t.priority)
-            stream.next_task, rest = ready[0], ready[1:]
+            # only claim the hot-path slot when it is free: device epilogs can
+            # release several tasks on the same stream within one progress
+            # sweep, and overwriting a pending next_task would lose it forever
+            # (mirrors __parsec_schedule_vp pushing the displaced task back)
+            if stream.next_task is None:
+                stream.next_task, rest = ready[0], ready[1:]
+            else:
+                rest = ready
             if rest:
                 self.schedule(rest, stream)
 

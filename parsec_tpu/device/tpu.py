@@ -97,6 +97,7 @@ class TPUDevice(DeviceModule):
         self._prof_stream = None
         self._prof_keys = None
         self._lru: "collections.OrderedDict[Any, DataCopy]" = collections.OrderedDict()
+        self._lru_sizes: Dict[Any, int] = {}   # accounted bytes per key
         self._resident_bytes = 0
         budget = mca.get("device_tpu_max_bytes", 0)
         if not budget:
@@ -356,9 +357,14 @@ class TPUDevice(DeviceModule):
 
     # ------------------------------------------------------------- LRU heap
     def _lru_touch(self, key: Any, copy: DataCopy) -> None:
-        prev = self._lru.pop(key, None)
-        if prev is None:
-            self._resident_bytes += _nbytes(copy.payload)
+        # account by the size actually resident under this key: an epilog may
+        # rebind the copy's payload to a different-sized array, and the budget
+        # must follow (the eviction math drifts otherwise)
+        self._lru.pop(key, None)
+        new_size = _nbytes(copy.payload)
+        old_size = self._lru_sizes.get(key, 0)
+        self._resident_bytes += new_size - old_size
+        self._lru_sizes[key] = new_size
         self._lru[key] = copy
 
     def evict_bytes(self, nbytes: int) -> int:
@@ -378,7 +384,7 @@ class TPUDevice(DeviceModule):
                         and data.newest_copy() is copy:
                     self._stage_out(data, copy)
                 self._lru.pop(key)
-                self._resident_bytes -= _nbytes(copy.payload)
+                self._resident_bytes -= self._lru_sizes.pop(key, 0)
                 copy.coherency_state = COHERENCY_INVALID
                 copy.payload = None
                 break
@@ -400,7 +406,7 @@ class TPUDevice(DeviceModule):
                         and data.newest_copy() is copy:
                     self._stage_out(data, copy)   # dirty: write back first
                 self._lru.pop(key)
-                self._resident_bytes -= _nbytes(copy.payload)
+                self._resident_bytes -= self._lru_sizes.pop(key, 0)
                 copy.coherency_state = COHERENCY_INVALID
                 copy.payload = None
                 evicted = True
@@ -410,6 +416,8 @@ class TPUDevice(DeviceModule):
 
     def fini(self) -> None:
         self._lru.clear()
+        self._lru_sizes.clear()
+        self._resident_bytes = 0
         self._pending.clear()
 
 

@@ -181,6 +181,17 @@ class DTDTaskpool(Taskpool):
     """Ref: parsec_dtd_taskpool_new (insert_function.c:1513)."""
 
     def __init__(self, context: Context, name: str = "dtd") -> None:
+        # per-context (i.e. per-rank) sequence number per base name: every
+        # rank constructs its taskpools in the same order, so "dtd#3" means
+        # the same pool on all ranks while two concurrently-live pools can
+        # never collide in the remote-dep registry
+        seqs = getattr(context, "_dtd_name_seq", None)
+        if seqs is None:
+            seqs = context._dtd_name_seq = {}
+        seq = seqs.get(name, 0)
+        seqs[name] = seq + 1
+        if seq:
+            name = f"{name}#{seq}"
         super().__init__(name)
         self.ctx = context
         self._classes: Dict[Any, DTDTaskClass] = {}

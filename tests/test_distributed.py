@@ -382,3 +382,85 @@ def test_distributed_getrf():
             M[m*ts:(m+1)*ts, k*ts:(k+1)*ts] = tile
     L, U = unpack_lu(M)
     np.testing.assert_allclose(L @ U, a, rtol=2e-2, atol=2e-2)
+
+
+def _bump(x):
+    return x + 1.0
+
+
+def test_early_activate_parked_until_registration():
+    """A data activate that lands before the receiving rank has registered
+    the taskpool must be parked and replayed at registration — not dropped
+    (regression: the fourcounter recv count stayed short of sent and the
+    multicast forward was lost -> distributed hang). Rank 0 races ahead;
+    ranks 1 and 2 register late, and the chain multicast means rank 1 also
+    has to FORWARD the parked payload to rank 2 after it registers."""
+    import time
+
+    def program(rank, fabric):
+        ctx = _mkctx(rank, fabric)
+        A = TwoDimBlockCyclic("EARLY", 12, 4, 4, 4, P=3, Q=1,
+                              nodes=3, myrank=rank)
+        A.fill(lambda m, n: np.full((4, 4), float(m), np.float32))
+        if rank > 0:
+            time.sleep(0.3 * rank)   # let rank 0's sends land first
+        tp = DTDTaskpool(ctx, "early")
+        src = tp.tile_of(A, 0, 0)          # owned by rank 0
+        outs = [tp.tile_of(A, m, 0) for m in range(3)]
+        # rank 0 writes src, then every rank's own tile reads it: the write
+        # completes on rank 0 long before ranks 1/2 even construct the pool
+        tp.insert_task(_bump, (src, RW), jit=False, name="w")
+        for m in (1, 2):
+            tp.insert_task(lambda x, s: x + s[0, 0], (outs[m], RW),
+                           (src, READ), jit=False, name=f"r{m}")
+        tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30); ctx.fini()
+        if rank > 0:
+            return float(np.asarray(
+                A.data_of(rank, 0).newest_copy().payload)[0, 0])
+        return None
+
+    results = run_distributed(3, program, timeout=60)
+    # src became 1.0 after the bump; each reader adds it to its own tile (m)
+    assert results[1] == 2.0 and results[2] == 3.0
+
+
+def test_dtd_taskpool_names_unique_per_context():
+    """Two concurrently-constructible pools with the same base name must get
+    distinct registry names (regression: second pool overwrote the first in
+    the remote-dep registry, misrouting activates and termdet tokens)."""
+    import parsec_tpu as pt
+    ctx = pt.Context(nb_cores=1)
+    tp1 = DTDTaskpool(ctx, "samename")
+    tp2 = DTDTaskpool(ctx, "samename")
+    assert tp1.name != tp2.name
+    tp1.wait(); tp1.close()
+    tp2.wait(); tp2.close()
+    ctx.wait(); ctx.fini()
+
+
+def test_comm_state_gc_after_termination():
+    """Per-payload bookkeeping (_received/_sent/applied versions) is dropped
+    once the taskpool's global termination is declared (regression:
+    unbounded growth in long-running distributed jobs)."""
+    def program(rank, fabric):
+        ctx = _mkctx(rank, fabric)
+        A = TwoDimBlockCyclic("GC", 32, 32, 16, 16, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        A.fill(lambda m, n: np.ones((16, 16), np.float32))
+        B = TwoDimBlockCyclic("GCB", 32, 32, 16, 16, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        B.fill(lambda m, n: np.ones((16, 16), np.float32))
+        C = TwoDimBlockCyclic("GCC", 32, 32, 16, 16, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        C.fill(lambda m, n: np.zeros((16, 16), np.float32))
+        tp = DTDTaskpool(ctx, "gcpool")
+        insert_gemm_tasks(tp, A, B, C)
+        tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30)
+        eng = ctx.comm
+        leftovers = (len(eng._received), len(eng._sent),
+                     len(eng._applied_version), len(eng._tp_keys))
+        ctx.fini()
+        return leftovers
+
+    for leftovers in run_distributed(2, program, timeout=60):
+        assert leftovers == (0, 0, 0, 0), leftovers
