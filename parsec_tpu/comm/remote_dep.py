@@ -327,18 +327,9 @@ class RemoteDepEngine:
     # ------------------------------------------------------------ AM handlers
     def _on_activate(self, ce, src, hdr, payload) -> None:
         name = hdr.get("tp")
-        tp = self._taskpools.get(name)
-        if tp is None and name is not None:
-            # activate raced ahead of local taskpool registration: park it
-            # (counting it now would be lost; forwarding needs the taskpool).
-            # Re-check under _lock — registration publishes there, so either
-            # we see the pool or our parked AM is visible to its replay.
-            with self._lock:
-                tp = self._taskpools.get(name)
-                if tp is None:
-                    self._early_ams.setdefault(name, []).append(
-                        ("activate", src, hdr, payload))
-                    return
+        tp, parked = self._taskpool_or_park(name, "activate", src, hdr, payload)
+        if parked:
+            return
         if tp is not None:
             self.fourcounter.message_received(tp)
         if hdr.get("ptg"):
@@ -360,16 +351,28 @@ class RemoteDepEngine:
 
     def _on_put(self, ce, src, hdr, payload) -> None:
         origin = hdr.get("origin") or {}
-        name = origin.get("tp")
+        tp, parked = self._taskpool_or_park(origin.get("tp"), "put",
+                                            src, hdr, payload)
+        if parked:
+            return
+        self._data_arrived(tp, origin, payload, src)
+
+    def _taskpool_or_park(self, name, kind, src, hdr, payload):
+        """Resolve a taskpool by name, or park the AM for replay when the
+        name is known but not registered yet (the AM raced ahead of local
+        registration — counting/forwarding it now would lose it). Returns
+        (taskpool, parked). The re-check happens under _lock: registration
+        publishes there, so either we see the pool or our parked AM is
+        visible to its replay."""
         tp = self._taskpools.get(name)
         if tp is None and name is not None:
             with self._lock:
                 tp = self._taskpools.get(name)
                 if tp is None:
                     self._early_ams.setdefault(name, []).append(
-                        ("put", src, hdr, payload))
-                    return
-        self._data_arrived(tp, origin, payload, src)
+                        (kind, src, hdr, payload))
+                    return None, True
+        return tp, False
 
     def _data_arrived(self, tp, hdr, payload, src) -> None:
         key = hdr["key"]

@@ -49,6 +49,9 @@ mca.register("device_tpu_batch_max", 16,
 mca.register("device_tpu_over_cpu", False,
              "TEST MODE: register the device module over a host jax device",
              type=bool)
+mca.register("device_tpu_over_cpu_index", 0,
+             "TEST MODE: which host jax device to register over (lets each "
+             "in-process rank bind a distinct virtual device)", type=int)
 
 
 class TPUTask:
@@ -244,13 +247,16 @@ class TPUDevice(DeviceModule):
                 inputs.append(None)
                 continue
             copy_in = slot.data_in
-            data = copy_in.original
+            # PTG intermediates may ride as raw arrays (no backing Data);
+            # they bypass the LRU heap and just get placed on-device
+            data = getattr(copy_in, "original", None)
             if data is not None:
                 dev_copy = (gt.stage_in or self._default_stage_in)(data, flow.access)
                 slot.data_in = dev_copy
                 inputs.append(dev_copy.payload)
             else:
-                inputs.append(self._jax.device_put(copy_in.payload, self.jax_device))
+                payload = getattr(copy_in, "payload", copy_in)
+                inputs.append(self._jax.device_put(payload, self.jax_device))
         return inputs
 
     def _submit_one_retry(self, gt: TPUTask) -> bool:
@@ -318,7 +324,7 @@ class TPUDevice(DeviceModule):
             oi += 1
             slot = task.data[flow.flow_index]
             src = slot.data_in
-            data = src.original if src is not None else None
+            data = getattr(src, "original", None)
             if data is not None:
                 copy = data.get_copy(self.device_index)
                 if copy is None:
@@ -453,14 +459,18 @@ def discover_tpu_devices() -> List[TPUDevice]:
 
     def _probe() -> None:
         try:
+            cpus = []
             for d in jax.devices():
                 if d.platform in ("tpu", "gpu", "axon"):
                     result.append(TPUDevice(d))
                 elif over_cpu and d.platform == "cpu":
-                    # test mode: drive the full async device pipeline
-                    # (stage-in, LRU, events, batching) over a host device
-                    result.append(TPUDevice(d))
-                    break
+                    cpus.append(d)
+            if not result and cpus:
+                # test mode: drive the full async device pipeline (stage-in,
+                # LRU, events, batching) over one host device — selectable so
+                # oversubscribed ranks can spread over a virtual device mesh
+                idx = mca.get("device_tpu_over_cpu_index", 0) % len(cpus)
+                result.append(TPUDevice(cpus[idx]))
         except Exception as e:
             output.debug_verbose(1, "device", f"jax.devices() failed: {e}")
         finally:

@@ -105,3 +105,35 @@ def test_eviction_under_pressure(dctx):
         assert np.allclose(np.asarray(A.data_of(m, 0).newest_copy().payload),
                            m + 0.5)
     assert dev._resident_bytes <= dev._budget + 16 * 16 * 4
+
+
+def test_ptg_body_through_device_module(dctx):
+    """PTG [type=TPU] bodies route through the async device module; PTG
+    intermediates ride as raw arrays without a backing Data (regression:
+    _gather_inputs/_epilog assumed DataCopy everywhere and crashed on
+    ArrayImpl inputs)."""
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+
+    src = """
+%global KT
+%global descC
+
+STEP(k)
+  k = 0 .. KT-1
+  : descC(0, 0)
+  RW C <- (k == 0) ? descC(0, 0) : C STEP(k-1)
+       -> (k < KT-1) ? C STEP(k+1) : descC(0, 0)
+BODY [type=TPU]
+  C = C + 1.0
+END
+"""
+    dev = _tpu_dev(dctx)
+    C = TiledMatrix("PDEV", 8, 8, 8, 8)
+    C.fill(lambda m, n: np.zeros((8, 8), np.float32))
+    prog = compile_ptg(src, "pdev")
+    tp = prog.instantiate(dctx, globals={"KT": 5},
+                          collections={"descC": C}, name="pdev")
+    dctx.add_taskpool(tp)
+    dctx.wait(timeout=30)
+    np.testing.assert_allclose(C.to_dense(), np.full((8, 8), 5.0), rtol=1e-6)
+    assert dev.executed_tasks >= 5
