@@ -142,6 +142,24 @@ def _vmapped(fn: Callable):
     return j
 
 
+_host_dev_cache = [False, None]   # [resolved, device]
+
+
+def _host_device():
+    """The host jax device, resolved once (a per-task jax.local_devices()
+    lookup showed up in the benchmark profile). Only a successful lookup is
+    cached: a transient backend failure (flaky accelerator discovery) must
+    not latch None for the process lifetime."""
+    if not _host_dev_cache[0]:
+        try:
+            import jax
+            _host_dev_cache[1] = jax.local_devices(backend="cpu")[0]
+            _host_dev_cache[0] = True
+        except Exception:
+            return None
+    return _host_dev_cache[1]
+
+
 def _jitted(fn: Callable):
     j = _jit_cache.get(fn)
     if j is None:
@@ -463,18 +481,33 @@ class DTDTaskpool(Taskpool):
         # dominant cost for jax-expressed bodies (compiled once per class)
         if self._jittable(task):
             fn = tc.jitted()
-            vals = [np.asarray(v) if isinstance(v, (int, float)) else v
-                    for v in vals]
+            cpu = _host_device()
             import jax
-            try:
-                cpu = jax.local_devices(backend="cpu")[0]
-            except Exception:
-                cpu = None
+            conv = []
+            for v in vals:
+                if isinstance(v, (int, float)):
+                    v = np.asarray(v)
+                elif cpu is not None and isinstance(v, np.ndarray):
+                    v = jax.device_put(v, cpu)
+                conv.append(v)
+            # persist converted flow payloads on their copies: each tile
+            # crosses into the backend ONCE per DAG instead of on every
+            # consuming task (the dominant re-copy cost for READ panels).
+            # Only when the conversion is lossless — device_put canonicalizes
+            # 64-bit dtypes under default x64-disabled jax, and that must
+            # stay confined to the jitted computation, not the stored copy
+            for (kind, fi), cv in zip(task.arg_spec, conv):
+                if kind == "flow":
+                    slot = task.data[fi]
+                    if slot.data_in is not None and \
+                            isinstance(slot.data_in.payload, np.ndarray) and \
+                            getattr(cv, "dtype", None) == slot.data_in.payload.dtype:
+                        slot.data_in.payload = cv
             if cpu is not None:
                 with jax.default_device(cpu):
-                    outs = self._apply_outputs(task, fn(*vals))
+                    outs = self._apply_outputs(task, fn(*conv))
             else:
-                outs = self._apply_outputs(task, fn(*vals))
+                outs = self._apply_outputs(task, fn(*conv))
         else:
             outs = self._apply_outputs(task, tc.fn(*vals))
         oi = 0
