@@ -8,7 +8,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def maybe_force_cpu() -> None:
     """Examples run anywhere: fall back to the CPU backend when no healthy
-    accelerator is reachable (EXAMPLES_CPU=1 forces it)."""
-    if os.environ.get("EXAMPLES_CPU") == "1":
+    accelerator is reachable (EXAMPLES_CPU=1 forces it; the multi-process
+    launcher sets PARSEC_TPU_FORCE_CPU per rank after its single probe)."""
+    if os.environ.get("EXAMPLES_CPU") == "1" \
+            or os.environ.get("PARSEC_TPU_FORCE_CPU") == "1":
         import jax
         jax.config.update("jax_platforms", "cpu")

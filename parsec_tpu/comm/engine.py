@@ -56,6 +56,8 @@ class CommEngine:
         self.nb_ranks = nb_ranks
         self._tags: Dict[int, AMRegistration] = {}
         self._lock = threading.Lock()
+        self._handles: Dict[int, Any] = {}
+        self._next_handle = 0
 
     # --- active messages ----------------------------------------------------
     def tag_register(self, tag: int, callback, msg_size: int = 4096) -> None:
@@ -70,20 +72,32 @@ class CommEngine:
                 payload: Any = None) -> None:
         raise NotImplementedError
 
-    # --- one-sided ----------------------------------------------------------
+    # --- one-sided (emulated over two-sided AMs with internal handshake
+    # tags, exactly like the reference emulates RDMA over MPI;
+    # parsec_mpi_funnelled.c) — shared by every two-sided backend ----------
     def mem_register(self, buf) -> Any:
-        return buf
+        with self._lock:
+            h = self._next_handle
+            self._next_handle += 1
+            self._handles[h] = buf
+        return h
 
     def mem_unregister(self, handle) -> None:
-        pass
+        with self._lock:
+            self._handles.pop(handle, None)
+
+    def resolve(self, handle):
+        return self._handles.get(handle)
 
     def put(self, dst: int, local_buf, remote_handle, on_complete=None) -> None:
-        """Emulated one-sided put (the reference emulates over two-sided MPI
-        with internal handshake tags, parsec_mpi_funnelled.c)."""
-        raise NotImplementedError
+        self.send_am(TAG_INTERNAL_PUT, dst, {"handle": remote_handle}, local_buf)
+        if on_complete is not None:
+            on_complete()
 
     def get(self, src: int, remote_handle, on_complete=None) -> None:
-        raise NotImplementedError
+        self.send_am(TAG_INTERNAL_GET, src,
+                     {"handle": remote_handle, "requester": self.my_rank}, None)
+        # completion arrives as the matching PUT from the target
 
     # --- progress / sync ----------------------------------------------------
     def progress(self) -> int:

@@ -80,44 +80,17 @@ class ThreadsCE(CommEngine):
     def __init__(self, fabric: ThreadFabric, my_rank: int) -> None:
         super().__init__(my_rank, fabric.nb_ranks)
         self.fabric = fabric
-        self._handles: Dict[int, Any] = {}
-        self._next_handle = 0
         self.sent_msgs = 0
         self.recv_msgs = 0
 
     # --- active messages ----------------------------------------------------
     def send_am(self, tag: int, dst: int, header: Any, payload: Any = None) -> None:
-        if dst == self.my_rank:
-            # loopback delivery still goes through the queue for ordering
-            self.fabric.send(dst, (tag, self.my_rank, header, payload))
-        else:
-            self.fabric.send(dst, (tag, self.my_rank, header, payload))
+        # loopback (dst == my_rank) rides the same queue: delivery stays
+        # ordered with network traffic and only happens from progress()
+        self.fabric.send(dst, (tag, self.my_rank, header, payload))
         self.sent_msgs += 1
 
-    # --- one-sided (emulated with internal handshake, like the reference) ---
-    def mem_register(self, buf) -> int:
-        h = self._next_handle
-        self._next_handle += 1
-        self._handles[h] = buf
-        return h
-
-    def mem_unregister(self, handle: int) -> None:
-        self._handles.pop(handle, None)
-
-    def resolve(self, handle: int):
-        return self._handles.get(handle)
-
-    def put(self, dst: int, local_buf, remote_handle, on_complete=None) -> None:
-        from .engine import TAG_INTERNAL_PUT
-        self.send_am(TAG_INTERNAL_PUT, dst, {"handle": remote_handle}, local_buf)
-        if on_complete is not None:
-            on_complete()
-
-    def get(self, src: int, remote_handle, on_complete=None) -> None:
-        from .engine import TAG_INTERNAL_GET
-        self.send_am(TAG_INTERNAL_GET, src,
-                     {"handle": remote_handle, "requester": self.my_rank}, None)
-        # completion arrives as the matching PUT from the target
+    # one-sided put/get + handle table inherited from CommEngine
 
     # --- progress -----------------------------------------------------------
     def progress(self, max_msgs: int = 64) -> int:

@@ -1,0 +1,99 @@
+"""``python -m parsec_tpu.launch -n N script.py [args...]`` — the mpiexec.
+
+Spawns N copies of ``script.py`` as real OS processes, each with
+``PARSEC_TPU_RANK`` / ``PARSEC_TPU_NPROCS`` / ``PARSEC_TPU_RDV`` set; the
+script calls :func:`parsec_tpu.comm.tcp.init_from_env` to join the TCP mesh
+(its `MPI_Init` moment). Stands where ``mpiexec -n N`` stands in the
+reference's workflow (tests/CMakeLists.txt:1032-1042 oversubscribed-host
+test mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from .comm.tcp import ENV_NPROCS, ENV_RANK, ENV_RDV, _free_port
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="parsec_tpu.launch",
+                                 description="run a script on N TCP-mesh ranks")
+    ap.add_argument("-n", "--np", type=int, default=2, dest="nprocs")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend on every rank (no probe)")
+    ap.add_argument("script")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    opts = ap.parse_args(argv)
+
+    # one accelerator decision for the whole job, made HERE: ranks must never
+    # probe concurrently (a single-session TPU transport wedges under
+    # multiple clients), and a lone chip belongs to rank 0 only
+    accel_ok = False
+    if not opts.cpu:
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=90)
+            plat = (p.stdout.strip().splitlines()[-1]
+                    if p.returncode == 0 and p.stdout.strip() else "")
+            accel_ok = plat in ("tpu", "axon", "gpu")
+        except Exception:
+            accel_ok = False
+
+    rdv = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(opts.nprocs):
+        env = dict(os.environ)
+        env[ENV_RANK] = str(rank)
+        env[ENV_NPROCS] = str(opts.nprocs)
+        env[ENV_RDV] = rdv
+        if not accel_ok or rank > 0:
+            env["PARSEC_TPU_FORCE_CPU"] = "1"
+        # each rank leads its own process group so cleanup can reach
+        # grandchildren even if the launcher itself is killed mid-wait
+        procs.append(subprocess.Popen(
+            [sys.executable, opts.script, *opts.args], env=env,
+            start_new_session=True))
+    rc = 0
+    deadline = time.monotonic() + opts.timeout   # one job-wide deadline
+    try:
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                rc = rc or p.returncode
+            except subprocess.TimeoutExpired:
+                rc = 124
+                break
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                _kill_group(p, signal.SIGTERM)
+        t0 = time.monotonic()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, 5.0 - (time.monotonic() - t0)))
+                except subprocess.TimeoutExpired:
+                    _kill_group(p, signal.SIGKILL)
+    return rc
+
+
+def _kill_group(p: subprocess.Popen, sig) -> None:
+    try:
+        os.killpg(p.pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            p.send_signal(sig)
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,3 +19,15 @@ def test_example_runs(ex):
     out = subprocess.run([sys.executable, fname], cwd=EX_DIR, env=env,
                          capture_output=True, text=True, timeout=110)
     assert out.returncode == 0, out.stderr[-2000:]
+
+
+def test_example_tcp_launch():
+    """Ex09 goes through the real multi-process launcher CLI."""
+    fname = "ex09_tcp_launch.py"
+    env = dict(os.environ, EXAMPLES_CPU="1")
+    out = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.launch", "-n", "2", "--cpu",
+         os.path.join("examples", fname)],
+        cwd=os.path.dirname(EX_DIR), env=env,
+        capture_output=True, text=True, timeout=200)
+    assert out.returncode == 0, out.stderr[-2000:]
