@@ -209,11 +209,13 @@ class RemoteDepEngine:
                                                    (tile, version, set()))
                     writer.remote_sends[id(tile)][2].add(dst_rank)
                     return
-        # data already available locally: send right away
+        # data already available locally: send right away (device arrays ship
+        # as-is — the transport decides if/when to materialize host bytes,
+        # ref parsec_mpi_allow_gpu_memory_communications)
         copy = tile.data.newest_copy()
         if copy is None:
             output.fatal(f"no data to send for {tile!r} v{version}")
-        self.send_data(tp, tile, version, [dst_rank], np.asarray(copy.payload))
+        self.send_data(tp, tile, version, [dst_rank], copy.payload)
 
     def dtd_task_completed(self, tp, task) -> None:
         """Local writer finished: fire queued remote sends (the remote
@@ -242,8 +244,7 @@ class RemoteDepEngine:
             if payload is None:
                 copy = tile.data.newest_copy()
                 payload = copy.payload
-            self.send_data(tp, tile, version, sorted(ranks),
-                           np.asarray(payload))
+            self.send_data(tp, tile, version, sorted(ranks), payload)
 
     def dtd_remote_task(self, tp, task) -> None:
         """Shadow of a task executing elsewhere — nothing to run locally;
@@ -257,9 +258,10 @@ class RemoteDepEngine:
         receiver re-derives which local tasks it feeds from the replicated
         program (the phantom-task trick of remote_dep_get_datatypes,
         remote_dep_mpi.c:861)."""
-        import numpy as np
         key = ("ptg", tp.name, tc.name, tuple(pkey) if isinstance(pkey, (list, tuple)) else pkey,
                flow_index)
+        if payload is not None and not hasattr(payload, "shape"):
+            payload = np.asarray(payload)
         with self._lock:
             ranks = [r for r in ranks if (key, 0, r) not in self._sent]
             for r in ranks:
@@ -267,7 +269,7 @@ class RemoteDepEngine:
         if not ranks:
             return
         tp.addto_nb_pending_actions(1)
-        self._cmds.append(("ptg_send", tp, key, ranks, np.asarray(payload)))
+        self._cmds.append(("ptg_send", tp, key, ranks, payload))
         self.ctx._work_event.set()
 
     def _do_ptg_send(self, tp, key, ranks, payload) -> None:
@@ -281,14 +283,19 @@ class RemoteDepEngine:
 
     # ------------------------------------------------------------ data path
     def send_data(self, tp, tile, version: int, ranks: Sequence[int],
-                  payload: np.ndarray) -> None:
+                  payload: Any) -> None:
         """Multicast (tile, version) to ``ranks`` through the selected tree.
+        ``payload`` may be a host numpy array or a device (jax) array —
+        device arrays cross in-process rank boundaries without a host
+        round-trip; wire transports materialize bytes at the frame boundary.
 
         Enqueues a command; the network is only touched from the progress
         path (the funnelled discipline)."""
         ranks = [r for r in ranks if r != self.ce.my_rank]
         if not ranks:
             return
+        if payload is not None and not hasattr(payload, "shape"):
+            payload = np.asarray(payload)   # scalar/list body outputs
         with self._lock:
             if tp is not None:
                 self._tp_keys.setdefault(tp.name, set()).add(tile.key)
@@ -387,8 +394,7 @@ class RemoteDepEngine:
                     self._sent.add((key, version, r))
             if fwd:
                 tp.addto_nb_pending_actions(1)
-                self._cmds.append(("send", tp, key, version, fwd,
-                                   np.asarray(payload)))
+                self._cmds.append(("send", tp, key, version, fwd, payload))
         waiters: List[Tuple] = []
         with self._lock:
             if hdr.get("tp") is not None:
@@ -404,10 +410,37 @@ class RemoteDepEngine:
             from ..data.data import COHERENCY_SHARED
             host = tile.data.get_copy(0)
             if host is None:
-                tile.data.create_copy(0, payload, COHERENCY_SHARED)
+                host = tile.data.create_copy(0, payload, COHERENCY_SHARED)
             else:
+                # NOTE: the superseded payload is NOT released here — parked
+                # _received entries, queued forwards, and waiter
+                # pending_inputs may still alias it; arena recycling happens
+                # at taskpool-termination GC (_gc_taskpool)
                 host.payload = payload
             tile.data.bump_version(0)
+            # preferred-device landing (ref: remote_dep_mpi_get_start
+            # allocating target copies on the consumer's device,
+            # remote_dep_mpi.c:2120): a tile that was device-resident stays
+            # device-resident — refresh its accelerator copy in place so the
+            # consumer's stage-in sees a version-valid device copy instead
+            # of forcing a host->device transfer
+            for dev_index, dcopy in list(tile.data.copies.items()):
+                if dev_index == 0 or dcopy is None:
+                    continue
+                dev = next((d for d in self.ctx.devices.devices
+                            if getattr(d, "device_index", None) == dev_index),
+                           None)
+                jd = getattr(dev, "jax_device", None)
+                if jd is None:
+                    continue
+                try:
+                    import jax
+                    dcopy.payload = jax.device_put(payload, jd)
+                    dcopy.version = host.version
+                    dcopy.coherency_state = COHERENCY_SHARED
+                except Exception as e:  # noqa: BLE001 - fall back to host copy
+                    output.debug_verbose(1, "comm",
+                                         f"device landing failed: {e}")
         ready = []
         for wtp, task, flow_index in waiters:
             task.pending_inputs[flow_index] = payload
@@ -426,9 +459,8 @@ class RemoteDepEngine:
                 for r in fwd:
                     self._sent.add((key, 0, r))
             if fwd:
-                import numpy as np
                 tp.addto_nb_pending_actions(1)
-                self._cmds.append(("ptg_send", tp, key, fwd, np.asarray(payload)))
+                self._cmds.append(("ptg_send", tp, key, fwd, payload))
         if tp is None:
             output.warning(f"PTG payload for unknown taskpool {hdr.get('tp')!r}")
             return
@@ -553,6 +585,8 @@ class RemoteDepEngine:
         """Drop per-payload bookkeeping for a terminated taskpool: every
         reader has run, so parked payloads / send-dedup / applied-version
         entries for its tiles can never be consumed again."""
+        from ..data.arena import release_buffer
+        dropped: List[Any] = []
         with self._lock:
             keys = self._tp_keys.pop(name, set())
             # a tile key shared with a still-live pool stays accounted to it
@@ -561,10 +595,20 @@ class RemoteDepEngine:
                 keys -= other
                 if not keys:
                     break
+            # buffers that became live tile content must not be recycled
+            live = set()
+            for k in keys:
+                t = self._tiles.get(k)
+                c = t.data.get_copy(0) if t is not None else None
+                if c is not None and c.payload is not None:
+                    live.add(id(c.payload))
             for k in keys:
                 self._applied_version.pop(k, None)
                 self._tiles.pop(k, None)
             if keys:
+                for kv, p in self._received.items():
+                    if kv[0] in keys and id(p) not in live:
+                        dropped.append(p)
                 self._received = {kv: p for kv, p in self._received.items()
                                   if kv[0] not in keys}
             # tile-key entries + PTG send-dedup entries (which embed the
@@ -573,3 +617,7 @@ class RemoteDepEngine:
                           if s[0] not in keys
                           and not (isinstance(s[0], tuple) and len(s[0]) == 5
                                    and s[0][0] == "ptg" and s[0][1] == name)}
+        # recycle arena recv buffers outside the lock: termination guarantees
+        # no consumer, forward, or late expect can still reference them
+        for p in dropped:
+            release_buffer(p)

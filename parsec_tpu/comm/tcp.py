@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..utils import output
 from .engine import CommEngine, CAP_MULTITHREADED
 
@@ -50,10 +52,20 @@ _KIND_BAR = 1        # barrier arrival (sent to rank 0)
 _KIND_BAR_REL = 2    # barrier release (rank 0 -> all)
 
 
-def _send_frame(sock: socket.socket, lock: threading.Lock, obj) -> None:
+def _send_frame(sock: socket.socket, lock: threading.Lock, obj,
+                raw: Optional[memoryview] = None) -> None:
+    """Frame = [u32 pickle_len][pickle][u32 raw_len][raw bytes].
+
+    Array payloads travel in the raw part straight from the source buffer
+    (no pickle copy); the receiver reads them into an arena-allocated
+    buffer (the reference allocates remote copies from the dep's arena,
+    remote_dep_mpi.c:2120)."""
     blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    rl = 0 if raw is None else len(raw)
     with lock:
-        sock.sendall(_LEN.pack(len(blob)) + blob)
+        sock.sendall(_LEN.pack(len(blob)) + blob + _LEN.pack(rl))
+        if rl:
+            sock.sendall(raw)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -66,6 +78,16 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
+def _recv_exact_into(sock: socket.socket, mv: memoryview) -> bool:
+    off, n = 0, len(mv)
+    while off < n:
+        r = sock.recv_into(mv[off:])
+        if r == 0:
+            return False
+        off += r
+    return True
+
+
 def _recv_frame(sock: socket.socket):
     hdr = _recv_exact(sock, _LEN.size)
     if hdr is None:
@@ -73,7 +95,36 @@ def _recv_frame(sock: socket.socket):
     blob = _recv_exact(sock, _LEN.unpack(hdr)[0])
     if blob is None:
         return None
-    return pickle.loads(blob)
+    obj = pickle.loads(blob)
+    rhdr = _recv_exact(sock, _LEN.size)
+    if rhdr is None:
+        return None
+    rl = _LEN.unpack(rhdr)[0]
+    if isinstance(obj, tuple) and obj and obj[0] == _KIND_AM:
+        kind, tag, src, header, inline, meta = obj
+        if rl:
+            # land the array in an arena recv buffer of its size class;
+            # a capped-out arena degrades to a plain allocation rather
+            # than killing the reader
+            from ..data.arena import arena_for, attach_chunk
+            shape, dtype_str = meta
+            chunk = None
+            try:
+                chunk = arena_for(shape, np.dtype(dtype_str)).allocate()
+                buf = chunk.buffer
+            except MemoryError:
+                buf = np.empty(shape, np.dtype(dtype_str))
+            if not _recv_exact_into(sock, memoryview(buf).cast("B")):
+                if chunk is not None:
+                    chunk.free()
+                return None
+            if chunk is not None:
+                attach_chunk(buf, chunk)
+            return (kind, tag, src, header, buf)
+        return (kind, tag, src, header, inline)
+    if rl and _recv_exact(sock, rl) is None:   # non-AM frames carry no raw
+        return None
+    return obj
 
 
 class TCPCE(CommEngine):
@@ -183,6 +234,12 @@ class TCPCE(CommEngine):
                 frame = _recv_frame(sock)
             except OSError:
                 frame = None
+            except Exception as e:  # noqa: BLE001 - corrupt frame/meta must
+                # not silently kill the reader: the rank would stop receiving
+                # from this peer with no attribution
+                output.warning(f"rank {self.my_rank}: reader from {rank} "
+                               f"died on {type(e).__name__}: {e}")
+                frame = None
             if frame is None:
                 return
             kind = frame[0]
@@ -203,8 +260,20 @@ class TCPCE(CommEngine):
         if dst == self.my_rank:
             self._inbound.append((tag, dst, header, payload))
             return
+        meta, raw, inline = None, None, payload
+        if payload is not None and hasattr(payload, "shape") \
+                and hasattr(payload, "dtype"):
+            # device arrays materialize host bytes HERE, at the wire
+            # boundary — the protocol layer above never forces them
+            a = np.ascontiguousarray(np.asarray(payload))
+            if a.dtype.kind in "fiub":   # exotic dtypes (bf16) ride pickle
+                meta = (tuple(a.shape), a.dtype.str)
+                raw = memoryview(a).cast("B")
+                inline = None
+            else:
+                inline = a
         _send_frame(self._peers[dst], self._peer_locks[dst],
-                    (_KIND_AM, tag, self.my_rank, header, payload))
+                    (_KIND_AM, tag, self.my_rank, header, inline, meta), raw)
 
     # one-sided put/get + handle table inherited from CommEngine
 

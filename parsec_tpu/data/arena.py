@@ -108,3 +108,43 @@ def arena_for(shape: Tuple[int, ...], dtype=np.float32) -> Arena:
             a = Arena(shape, dtype)
             _registry[key] = a
         return a
+
+
+# buffer -> chunk bookkeeping for arena-backed receive buffers: the comm
+# transport allocates recv buffers from arenas (the reference allocates
+# remote copies from the dep's arena, remote_dep_mpi.c:2120); the protocol
+# layer releases them at safe points (taskpool-termination GC) without
+# knowing which transport (or whether an arena) produced the bytes.
+# Lifecycle: explicit release_buffer() recycles the buffer into the arena
+# cache; a buffer that instead dies naturally (became tile content, later
+# replaced) gives its slot back through a weakref finalizer so ``used``
+# accounting never drifts. The map holds no strong buffer reference.
+_chunks: Dict[int, ArenaChunk] = {}
+_chunks_lock = threading.Lock()
+
+
+def _buffer_died(bid: int) -> None:
+    with _chunks_lock:
+        chunk = _chunks.pop(bid, None)
+    if chunk is not None:
+        with chunk.arena._lock:
+            chunk.arena.used -= 1
+
+
+def attach_chunk(buffer: np.ndarray, chunk: ArenaChunk) -> None:
+    import weakref
+    chunk.buffer = None          # the buffer owns itself from here on
+    with _chunks_lock:
+        _chunks[id(buffer)] = chunk
+    weakref.finalize(buffer, _buffer_died, id(buffer))
+
+
+def release_buffer(buffer) -> None:
+    """Recycle ``buffer`` into its arena's cache if it came from one (no-op
+    otherwise). Only call at points where no consumer can still hold it —
+    the comm layer does this at taskpool-termination GC."""
+    with _chunks_lock:
+        chunk = _chunks.pop(id(buffer), None)
+    if chunk is not None:
+        chunk.buffer = buffer    # re-arm (release_chunk caches it)
+        chunk.free()

@@ -464,3 +464,44 @@ def test_comm_state_gc_after_termination():
 
     for leftovers in run_distributed(2, program, timeout=60):
         assert leftovers == (0, 0, 0, 0), leftovers
+
+
+def _produce_consume(rank, fabric):
+    """Rank 0's device module writes a tile (device-resident jax array);
+    rank 1 consumes it remotely."""
+    from parsec_tpu.utils import mca
+    ctx = _mkctx(rank, fabric)
+    A = TwoDimBlockCyclic("DD", 8, 8, 4, 4, P=2, Q=1, nodes=2, myrank=rank)
+    A.fill(lambda m, n: np.full((4, 4), 1.0, np.float32))
+    tp = DTDTaskpool(ctx, "devdirect")
+    src = tp.tile_of(A, 0, 0)   # rank 0
+    dst = tp.tile_of(A, 1, 0)   # rank 1
+    tp.insert_task(lambda x: x * 3.0, (src, RW), name="w")          # on dev
+    tp.insert_task(lambda y, x: y + x[0, 0], (dst, RW), (src, READ),
+                   name="r")
+    tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30)
+    out = None
+    if rank == 1:
+        import jax
+        got = src.data.get_copy(0).payload
+        out = (type(got).__name__, isinstance(got, np.ndarray),
+               isinstance(got, jax.Array),
+               float(np.asarray(A.data_of(1, 0).newest_copy().payload)[0, 0]))
+    ctx.fini()
+    return out
+
+
+def test_device_payload_ships_without_host_roundtrip():
+    """A device-resident producer tile crosses rank boundaries as a device
+    (jax) array — the protocol layer no longer forces np.asarray on sends
+    (ref: parsec_mpi_allow_gpu_memory_communications)."""
+    from parsec_tpu.utils import mca
+    mca.set("device_tpu_over_cpu", True)
+    try:
+        results = run_distributed(2, _produce_consume, timeout=60)
+    finally:
+        mca.params.unset("device_tpu_over_cpu")
+    tname, is_np, is_jax, val = results[1]
+    assert val == 4.0                      # 1 + 3*1
+    assert is_jax and not is_np, \
+        f"payload crossed as {tname}; expected a device (jax) array"

@@ -157,3 +157,36 @@ def test_tcp_dead_child_raises():
     """A rank that dies without reporting must raise, not yield None results."""
     with pytest.raises(RuntimeError, match="died without reporting"):
         run_distributed_procs(2, _crash_program, timeout=60)
+
+
+def _arena_recv_program(rank, ce):
+    _force_cpu()
+    from parsec_tpu.data.arena import arena_for
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+
+    ctx = _mkctx(rank, ce)
+    A = TwoDimBlockCyclic("AR", 32, 16, 16, 16, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    A.fill(lambda m, n: np.full((16, 16), float(m + 1), np.float32))
+    tp = DTDTaskpool(ctx, "arenarecv")
+    src = tp.tile_of(A, 0, 0)   # rank 0
+    dst = tp.tile_of(A, 1, 0)   # rank 1
+    tp.insert_task(lambda x: x + 1.0, (src, RW), name="w")
+    tp.insert_task(lambda y, x: y + x, (dst, RW), (src, READ), name="r")
+    tp.wait(timeout=60); tp.close(); ctx.wait(timeout=60); ctx.fini()
+    ce.fini()
+    stats = arena_for((16, 16), np.float32).stats()
+    val = float(np.asarray(A.data_of(1, 0).newest_copy().payload)[0, 0]) \
+        if rank == 1 else None
+    return (stats, val)
+
+
+def test_tcp_receives_land_in_arena_buffers():
+    """Wire payloads are read into arena-allocated buffers on the receiver
+    (ref: remote copies allocated from the dep's arena,
+    remote_dep_mpi.c:2120) — the arena high-water mark must show use."""
+    results = run_distributed_procs(2, _arena_recv_program, timeout=180)
+    stats1, val = results[1]
+    assert val == 4.0                       # 2 + (1+1)
+    assert stats1["hwm"] >= 1, f"receiver arena never used: {stats1}"
