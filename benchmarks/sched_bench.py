@@ -45,6 +45,69 @@ def bench_one(sched: str, ntasks: int) -> dict:
             "ntasks": ntasks}
 
 
+def bench_unbalanced(sched: str, chain_len: int = 200,
+                     nfill: int = 1500) -> dict:
+    """Policy-separation probe: one high-priority serial chain (the critical
+    path) races ``nfill`` independent zero-priority filler tasks inserted
+    FIRST. A priority-aware policy finishes the chain long before the
+    fillers drain; FIFO/random policies bury it. Reported as
+    ``chain_done_frac`` = (chain completion time) / (total makespan) —
+    lower is better.
+    """
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+
+    # the whole gated DAG must fit the DTD insertion window (2048), or the
+    # inserter stalls while nothing can drain
+    assert nfill + chain_len + 1 < 2048, "gated DAG exceeds the DTD window"
+    ctx = Context(nb_cores=1, scheduler=sched)
+    tp = DTDTaskpool(ctx, f"unbal-{sched}")
+    fill_tiles = [tp.tile_new((2, 2)) for _ in range(32)]
+    chain_tile = tp.tile_new((2, 2))
+    gate_tile = tp.tile_new((2, 2))
+    tdone = [None]
+
+    def filler(x, g):
+        return None
+
+    def link(x, g):
+        return x
+
+    def last(x, g):
+        tdone[0] = time.perf_counter()
+        return x
+
+    # everything reads the gate; the gate WRITER (inserted first, so every
+    # later reader depends on it in DTD program order) blocks on an event
+    # until insertion finishes — when it opens, the scheduler faces the
+    # full backlog at once and policy (not insertion order) decides when
+    # the chain finishes
+    import threading
+    release = threading.Event()
+
+    def gate(g):
+        release.wait(30)
+        return g
+
+    tp.insert_task(gate, (gate_tile, RW), jit=False, name="GATE")
+    for i in range(nfill):
+        tp.insert_task(filler, (fill_tiles[i % 32], READ), (gate_tile, READ),
+                       jit=False, name="FILL", priority=0)
+    for i in range(chain_len):
+        body = last if i == chain_len - 1 else link
+        tp.insert_task(body, (chain_tile, RW), (gate_tile, READ),
+                       jit=False, name="CHAIN", priority=1000)
+    t0 = time.perf_counter()
+    release.set()
+    tp.wait(); tp.close(); ctx.wait()
+    total = time.perf_counter() - t0
+    ctx.fini()
+    frac = (tdone[0] - t0) / total if tdone[0] else 1.0
+    return {"metric": "sched-unbalanced", "sched": sched,
+            "chain_done_frac": round(frac, 3),
+            "makespan_ms": round(total * 1e3, 1)}
+
+
 def main() -> None:
     import jax
     try:
@@ -56,6 +119,8 @@ def main() -> None:
     scheds = sys.argv[2].split(",") if len(sys.argv) > 2 else S.available()
     for s in scheds:
         print(json.dumps(bench_one(s, ntasks)), flush=True)
+    for s in scheds:
+        print(json.dumps(bench_unbalanced(s)), flush=True)
 
 
 if __name__ == "__main__":

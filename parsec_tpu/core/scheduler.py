@@ -30,6 +30,7 @@ data locality (which tiles stay HBM-resident), not CPU load balance.
 
 from __future__ import annotations
 
+import bisect
 import heapq
 import itertools
 import random
@@ -110,9 +111,12 @@ class _LockedHeap:
         self.lock = threading.Lock()
         self._ctr = itertools.count()
 
-    def push(self, task: Task, sign: int = -1) -> None:
+    def push(self, task: Task, sign: int = -1, tie_lifo: bool = False) -> None:
+        ctr = next(self._ctr)
         with self.lock:
-            heapq.heappush(self.heap, (sign * task.priority, next(self._ctr), task))
+            heapq.heappush(self.heap,
+                           (sign * task.priority,
+                            -ctr if tie_lifo else ctr, task))
 
     def pop(self) -> Optional[Task]:
         with self.lock:
@@ -124,113 +128,418 @@ class _LockedHeap:
         return len(self.heap)
 
 
-# ---------------------------------------------------------------------------
-# modules
-# ---------------------------------------------------------------------------
+class _HBBuffer:
+    """Hierarchical bounded buffer (redesign of parsec/hbbuffer.c:1-278):
+    fixed capacity; overflow spills through ``parent_push`` (another buffer
+    or the system dequeue); ``pop_best`` removes the highest-priority
+    element, ``pop_any`` the coldest (steal end)."""
+
+    __slots__ = ("cap", "items", "lock", "parent_push")
+
+    def __init__(self, cap: int, parent_push) -> None:
+        self.cap = max(1, cap)
+        self.items: List[Task] = []     # ascending priority; best at the end
+        self.lock = threading.Lock()
+        self.parent_push = parent_push
+
+    def push(self, tasks: List[Task]) -> None:
+        """Fill to capacity, spill the rest upward (hbbuffer_push_all)."""
+        with self.lock:
+            room = self.cap - len(self.items)
+            take, spill = tasks[:room], tasks[room:]
+            if take:
+                self.items.extend(take)
+                self.items.sort(key=lambda t: t.priority)
+        if spill:
+            self.parent_push(spill)
+
+    def push_by_priority(self, tasks: List[Task]) -> None:
+        """Merge then spill the LOWEST-priority overflow upward
+        (hbbuffer_push_all_by_priority): hot tasks stay local."""
+        with self.lock:
+            self.items.extend(tasks)
+            self.items.sort(key=lambda t: t.priority)
+            nspill = len(self.items) - self.cap
+            spill, self.items = (self.items[:nspill], self.items[nspill:]) \
+                if nspill > 0 else ([], self.items)
+        if spill:
+            self.parent_push(spill)
+
+    def pop_best(self) -> Optional[Task]:
+        with self.lock:
+            return self.items.pop() if self.items else None
+
+    def pop_any(self) -> Optional[Task]:
+        with self.lock:
+            return self.items.pop(0) if self.items else None
+
+    def __len__(self) -> int:
+        return len(self.items)
+
 
 class _LocalQueuesBase(SchedulerModule):
-    """Shared shape for per-stream-queue + steal modules
+    """Shared plumbing for the local-queues family: per-stream structures,
+    a shared system dequeue, and the distance-ordered steal walk
     (ref: parsec/mca/sched/sched_local_queues_utils.h)."""
-
-    lifo = False         # pop same end we push (depth-first) vs FIFO
-    use_priority = False
 
     def install(self, context) -> None:
         super().install(context)
         self._queues: Dict[int, object] = {}
         self._order: List[int] = []
+        self._system = _LockedDeque()
+        self._init_lock = threading.Lock()
 
-    def flow_init(self, stream) -> None:
-        q = _LockedHeap() if self.use_priority else _LockedDeque()
-        self._queues[stream.th_id] = q
-        self._order.append(stream.th_id)
+    def _system_push(self, tasks: List[Task]) -> None:
+        self._system.push_back(tasks)
 
     def _local(self, stream):
         return self._queues[stream.th_id]
+
+    def _steal_order(self, stream) -> List[int]:
+        """Victims by increasing topological distance: ring order, same
+        virtual process (NUMA-ish group) first — the hwloc-distance walk of
+        flow_*_init (sched_lfq_module.c / sched.h:210-335)."""
+        me = stream.th_id
+        n = len(self._order)
+        if n <= 1:
+            return []
+        start = self._order.index(me) if me in self._order else 0
+        order = [self._order[(start + d) % n] for d in range(1, n)]
+        my_vp = getattr(stream, "vp_id", 0)
+        order.sort(key=lambda tid: 0 if
+                   self.context.streams[tid].vp_id == my_vp else 1)
+        return order
+
+    def stats(self, stream):
+        return {"local_len": len(self._local(stream)),
+                "system_len": len(self._system)}
+
+
+# ---------------------------------------------------------------------------
+# modules
+# ---------------------------------------------------------------------------
+
+class SchedLFQ(_LocalQueuesBase):
+    """Local flat queues (default): per-stream bounded buffer (cap 4·ncores)
+    spilling straight to the shared system dequeue; distance-ordered steal
+    (ref: parsec/mca/sched/lfq/sched_lfq_module.c:73, hbbuffer.c)."""
+    name = "lfq"
+    priority = 20
+
+    def flow_init(self, stream) -> None:
+        cap = 4 * max(1, len(self.context.streams))
+        with self._init_lock:
+            self._queues[stream.th_id] = _HBBuffer(cap, self._system_push)
+            self._order.append(stream.th_id)
 
     def schedule(self, stream, tasks, distance: int = 0) -> None:
         tasks = list(tasks)
         if not tasks:
             return
-        # distance>0 pushes away from the hot end, as hbbuffer does in the
-        # reference (parsec/hbbuffer.c): locality hint, not a strict target.
-        q = self._local(stream)
-        if self.use_priority:
-            for t in tasks:
-                q.push(t)
-        elif distance == 0:
-            q.push_front(tasks)
-        else:
-            q.push_back(tasks)
+        if distance == 0:
+            self._local(stream).push(tasks)
+        else:                       # pushed away from the hot end
+            self._system.push_back(tasks)
 
     def select(self, stream):
-        q = self._local(stream)
-        t = q.pop() if self.use_priority else q.pop_front()
+        t = self._local(stream).pop_best()
         if t is not None:
             return t, 0
-        # work stealing by increasing topological distance: same virtual
-        # process (NUMA-ish group) first, then the rest — the hierarchy the
-        # reference's lfq walks through its bounded buffers
-        me = stream.th_id
-        n = len(self._order)
-        if n > 1:
-            my_vp = getattr(stream, "vp_id", 0)
-            ctx = getattr(self, "context", None)
-            start = self._order.index(me) if me in self._order else 0
-            order = [self._order[(start + d) % n] for d in range(1, n)]
-            if ctx is not None:
-                order.sort(key=lambda tid: 0 if
-                           ctx.streams[tid].vp_id == my_vp else 1)
-            for d, tid in enumerate(order, start=1):
-                victim = self._queues[tid]
-                t = victim.pop() if self.use_priority else victim.pop_back()
+        for d, tid in enumerate(self._steal_order(stream), start=1):
+            t = self._queues[tid].pop_any()
+            if t is not None:
+                return t, d
+        return self._system.pop_front(), len(self._order)
+
+
+class SchedPBQ(_LocalQueuesBase):
+    """Priority-based local bounded queues: like lfq but the buffer keeps
+    priority order on every push and spills its LOWEST-priority tasks to
+    the system queue — hot work never leaves the owning stream
+    (ref: sched_pbq, hbbuffer_push_all_by_priority)."""
+    name = "pbq"
+
+    flow_init = SchedLFQ.flow_init
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        if not tasks:
+            return
+        if distance == 0:
+            self._local(stream).push_by_priority(tasks)
+        else:
+            self._system.push_back(tasks)
+
+    select = SchedLFQ.select
+
+
+class SchedLHQ(_LocalQueuesBase):
+    """Local hierarchical queues: stream buffer -> shared per-VP buffer ->
+    system dequeue; overflow climbs the hierarchy level by level and select
+    walks it back down before crossing to other VPs
+    (ref: sched_lhq_module.c, nested hbbuffers per hwloc level)."""
+    name = "lhq"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._vp_queues: Dict[int, _HBBuffer] = {}
+
+    def flow_init(self, stream) -> None:
+        vp = getattr(stream, "vp_id", 0)
+        with self._init_lock:
+            vq = self._vp_queues.get(vp)
+            if vq is None:
+                nvp_cores = max(1, sum(
+                    1 for s in self.context.streams if s.vp_id == vp))
+                vq = _HBBuffer(max(96 // nvp_cores, nvp_cores),
+                               self._system_push)
+                self._vp_queues[vp] = vq
+            self._queues[stream.th_id] = _HBBuffer(
+                4 * max(1, len(self.context.streams)), vq.push)
+            self._order.append(stream.th_id)
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        if not tasks:
+            return
+        if distance == 0:
+            self._local(stream).push(tasks)
+        elif distance == 1:
+            self._vp_queues[getattr(stream, "vp_id", 0)].push(tasks)
+        else:
+            self._system.push_back(tasks)
+
+    def select(self, stream):
+        t = self._local(stream).pop_best()
+        if t is not None:
+            return t, 0
+        my_vp = getattr(stream, "vp_id", 0)
+        t = self._vp_queues[my_vp].pop_best()
+        if t is not None:
+            return t, 1
+        d = 1
+        for tid in self._steal_order(stream):
+            if self.context.streams[tid].vp_id == my_vp:
+                d += 1
+                t = self._queues[tid].pop_any()
+                if t is not None:
+                    return t, d
+        for vp, vq in self._vp_queues.items():
+            if vp != my_vp:
+                d += 1
+                t = vq.pop_any()
+                if t is not None:
+                    return t, d
+        for tid in self._steal_order(stream):
+            if self.context.streams[tid].vp_id != my_vp:
+                d += 1
+                t = self._queues[tid].pop_any()
+                if t is not None:
+                    return t, d
+        return self._system.pop_front(), d + 1
+
+    def stats(self, stream):
+        s = super().stats(stream)
+        s["vp_len"] = len(self._vp_queues.get(getattr(stream, "vp_id", 0), ()))
+        return s
+
+
+class _TaskHeap:
+    """A group of related ready tasks as one schedulable unit, ordered by
+    priority (redesign of parsec_heap_t, parsec/maxheap.c:1-385)."""
+
+    __slots__ = ("heap", "_ctr")
+
+    def __init__(self, tasks: List[Task]) -> None:
+        self._ctr = itertools.count()
+        self.heap = [(-t.priority, next(self._ctr), t) for t in tasks]
+        heapq.heapify(self.heap)
+
+    @property
+    def top_priority(self) -> int:
+        return -self.heap[0][0] if self.heap else -(1 << 62)
+
+    def pop(self) -> Optional[Task]:
+        return heapq.heappop(self.heap)[2] if self.heap else None
+
+    def split(self) -> Optional["_TaskHeap"]:
+        """Give away about half the tasks (heap_split_and_steal): the thief
+        walks off with a subtree, keeping sibling groups together."""
+        if len(self.heap) < 2:
+            return None
+        self.heap.sort()
+        mine, theirs = self.heap[::2], self.heap[1::2]
+        self.heap = mine
+        heapq.heapify(self.heap)
+        other = _TaskHeap([])
+        other.heap = theirs
+        heapq.heapify(other.heap)
+        return other
+
+    def __len__(self) -> int:
+        return len(self.heap)
+
+
+class SchedLTQ(_LocalQueuesBase):
+    """Local tree queues: every schedule() call becomes ONE heap of tasks;
+    streams pop the top of their best heap and keep the rest; a steal takes
+    the victim's best heap and SPLITS it, carrying half home — related
+    tasks migrate together (ref: sched_ltq_module.c + maxheap.c)."""
+    name = "ltq"
+
+    def flow_init(self, stream) -> None:
+        with self._init_lock:
+            self._queues[stream.th_id] = _LockedHeapList()
+            self._order.append(stream.th_id)
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        if not tasks:
+            return
+        self._local(stream).add(_TaskHeap(tasks))
+
+    def select(self, stream):
+        own: _LockedHeapList = self._local(stream)
+        t = own.pop_task()
+        if t is not None:
+            return t, 0
+        for d, tid in enumerate(self._steal_order(stream), start=1):
+            victim: _LockedHeapList = self._queues[tid]
+            stolen = victim.steal_half()
+            if stolen is not None:
+                t = stolen.pop()
+                if len(stolen):
+                    own.add(stolen)
                 if t is not None:
                     return t, d
         return None, 0
 
     def stats(self, stream):
-        return {"local_len": len(self._local(stream))}
+        q = self._local(stream)
+        return {"local_heaps": len(q.heaps),
+                "local_len": sum(len(h) for h in q.heaps)}
 
 
-class SchedLFQ(_LocalQueuesBase):
-    """Local flat queues (default; ref: parsec/mca/sched/lfq/sched_lfq_module.c)."""
-    name = "lfq"
-    priority = 20
+class _LockedHeapList:
+    """Per-stream list of _TaskHeaps (the hbbuffer-of-heaps of ltq)."""
+
+    __slots__ = ("heaps", "lock")
+
+    def __init__(self) -> None:
+        self.heaps: List[_TaskHeap] = []
+        self.lock = threading.Lock()
+
+    def add(self, h: _TaskHeap) -> None:
+        with self.lock:
+            self.heaps.append(h)
+
+    def pop_task(self) -> Optional[Task]:
+        with self.lock:
+            if not self.heaps:
+                return None
+            best = max(range(len(self.heaps)),
+                       key=lambda i: self.heaps[i].top_priority)
+            h = self.heaps[best]
+            t = h.pop()
+            if not len(h):
+                self.heaps.pop(best)
+            return t
+
+    def steal_half(self) -> Optional[_TaskHeap]:
+        with self.lock:
+            if not self.heaps:
+                return None
+            best = max(range(len(self.heaps)),
+                       key=lambda i: self.heaps[i].top_priority)
+            h = self.heaps[best]
+            half = h.split()
+            if half is not None:
+                return half
+            return self.heaps.pop(best)   # singleton: take it whole
 
 
 class SchedLL(_LocalQueuesBase):
-    """Local LIFO (ref: sched_ll): always push and pop the front (depth-first)."""
+    """Local LIFO: push and pop the same end (depth-first), steal the other
+    (ref: sched_ll)."""
     name = "ll"
+
+    def flow_init(self, stream) -> None:
+        with self._init_lock:
+            self._queues[stream.th_id] = _LockedDeque()
+            self._order.append(stream.th_id)
 
     def schedule(self, stream, tasks, distance: int = 0) -> None:
         tasks = list(tasks)
         if tasks:
             self._local(stream).push_front(tasks)
 
+    def select(self, stream):
+        t = self._local(stream).pop_front()
+        if t is not None:
+            return t, 0
+        for d, tid in enumerate(self._steal_order(stream), start=1):
+            t = self._queues[tid].pop_back()
+            if t is not None:
+                return t, d
+        return None, 0
+
 
 class SchedLLP(_LocalQueuesBase):
-    """Local LIFO with priorities (ref: sched_llp, 657 LoC)."""
+    """Local LIFO with priorities: an UNBOUNDED per-stream list kept in
+    priority order (LIFO among equals — latest insert at the head of its
+    priority class); no system queue; thieves take from the cold end
+    (ref: sched_llp, parsec_lifo_with_prio)."""
     name = "llp"
-    use_priority = True
+
+    def flow_init(self, stream) -> None:
+        with self._init_lock:
+            self._queues[stream.th_id] = _PrioLIFO()
+            self._order.append(stream.th_id)
+
+    def schedule(self, stream, tasks, distance: int = 0) -> None:
+        tasks = list(tasks)
+        if tasks:
+            self._local(stream).push(tasks)
+
+    def select(self, stream):
+        t = self._local(stream).pop_head()
+        if t is not None:
+            return t, 0
+        for d, tid in enumerate(self._steal_order(stream), start=1):
+            t = self._queues[tid].pop_tail()
+            if t is not None:
+                return t, d
+        return None, 0
 
 
-class SchedPBQ(_LocalQueuesBase):
-    """Priority-based local queues (ref: sched_pbq)."""
-    name = "pbq"
-    use_priority = True
+class _PrioLIFO:
+    """Priority-ordered LIFO (redesign of parsec_lifo_with_prio): head =
+    highest priority, newest first within a priority class."""
 
+    __slots__ = ("items", "lock")
 
-class SchedLTQ(_LocalQueuesBase):
-    """Local tree queues: heap-ordered local queues, nearest-neighbor steal
-    (ref: sched_ltq uses maxheaps per thread, parsec/maxheap.c)."""
-    name = "ltq"
-    use_priority = True
+    def __init__(self) -> None:
+        self.items: List[Task] = []   # descending priority
+        self.lock = threading.Lock()
 
+    def push(self, tasks: List[Task]) -> None:
+        with self.lock:
+            keys = [-t.priority for t in self.items]
+            for t in tasks:
+                i = bisect.bisect_left(keys, -t.priority)
+                self.items.insert(i, t)
+                keys.insert(i, -t.priority)
 
-class SchedLHQ(_LocalQueuesBase):
-    """Local hierarchical queues (ref: sched_lhq): per-thread queues with
-    hierarchy-ordered stealing; hierarchy degenerates to ring order here."""
-    name = "lhq"
+    def pop_head(self) -> Optional[Task]:
+        with self.lock:
+            return self.items.pop(0) if self.items else None
+
+    def pop_tail(self) -> Optional[Task]:
+        with self.lock:
+            return self.items.pop() if self.items else None
+
+    def __len__(self) -> int:
+        return len(self.items)
 
 
 class _GlobalBase(SchedulerModule):
@@ -281,7 +590,8 @@ class SchedRND(_GlobalBase):
 
 
 class _GlobalHeapBase(SchedulerModule):
-    sign = -1  # -1: highest priority first
+    sign = -1           # -1: highest priority first
+    tie_lifo = False    # FIFO among equal priorities
 
     def install(self, context) -> None:
         super().install(context)
@@ -292,15 +602,17 @@ class _GlobalHeapBase(SchedulerModule):
 
     def schedule(self, stream, tasks, distance: int = 0) -> None:
         for t in tasks:
-            self._heap.push(t, self.sign)
+            self._heap.push(t, self.sign, self.tie_lifo)
 
     def select(self, stream):
         return self._heap.pop(), 0
 
 
 class SchedAP(_GlobalHeapBase):
-    """Absolute priority (ref: sched_ap)."""
+    """Absolute priority (ref: sched_ap): depth-first (LIFO) among equal
+    priorities — the freshest ready task continues the critical path."""
     name = "ap"
+    tie_lifo = True
 
 
 class SchedSPQ(_GlobalHeapBase):

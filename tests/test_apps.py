@@ -163,7 +163,10 @@ def test_sched_bench_runs():
     assert out.returncode == 0, out.stderr[-1500:]
     lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
     assert {l["sched"] for l in lines} == {"lfq", "ap"}
-    assert all(l["value"] > 0 for l in lines)
+    ep = [l for l in lines if l["metric"] == "scheduler-tasks-per-sec"]
+    unbal = [l for l in lines if l["metric"] == "sched-unbalanced"]
+    assert len(ep) == 2 and all(l["value"] > 0 for l in ep)
+    assert len(unbal) == 2 and all(0 < l["chain_done_frac"] <= 1 for l in unbal)
 
 
 def test_stencil2d(ctx):

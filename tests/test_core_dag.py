@@ -290,3 +290,57 @@ def test_context_argv_mca():
     finally:
         ctx.fini()
         mca.params._params["sched"].has_cmdline = False  # restore default
+
+
+@pytest.mark.parametrize("sched,chain_early", [("pbq", True), ("ap", True),
+                                               ("ltq", True), ("gd", False),
+                                               ("rnd", False)])
+def test_scheduler_policy_separation(sched, chain_early):
+    """Policy probe (behavioral, order-based): a high-priority serial chain
+    races a gated backlog of low-priority fillers. Priority-aware modules
+    must finish the chain before most fillers run; FIFO/random must not
+    (the structural distinctness the reference gets from hbbuffer/maxheap
+    designs — sched_bench.py reports the timing version)."""
+    import threading
+    from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+
+    ctx = Context(nb_cores=1, scheduler=sched)
+    tp = DTDTaskpool(ctx, f"sep-{sched}")
+    fill_tiles = [tp.tile_new((2, 2)) for _ in range(16)]
+    chain_tile = tp.tile_new((2, 2))
+    gate_tile = tp.tile_new((2, 2))
+    nfill, chain_len = 400, 40
+    fills_done = [0]
+    fills_at_chain_end = [None]
+    release = threading.Event()
+
+    def gate(g):
+        release.wait(30)
+        return g
+
+    def filler(x, g):
+        fills_done[0] += 1
+
+    def link(x, g):
+        return x
+
+    def last(x, g):
+        fills_at_chain_end[0] = fills_done[0]
+        return x
+
+    tp.insert_task(gate, (gate_tile, RW), jit=False, name="GATE")
+    for i in range(nfill):
+        tp.insert_task(filler, (fill_tiles[i % 16], READ), (gate_tile, READ),
+                       jit=False, name="FILL", priority=0)
+    for i in range(chain_len):
+        tp.insert_task(last if i == chain_len - 1 else link,
+                       (chain_tile, RW), (gate_tile, READ),
+                       jit=False, name="CHAIN", priority=1000)
+    release.set()
+    tp.wait(); tp.close(); ctx.wait(); ctx.fini()
+    assert fills_at_chain_end[0] is not None
+    frac = fills_at_chain_end[0] / nfill
+    if chain_early:
+        assert frac < 0.5, f"{sched}: chain finished after {frac:.0%} of fillers"
+    else:
+        assert frac > 0.5, f"{sched}: chain finished after only {frac:.0%}"
