@@ -252,14 +252,15 @@ class RemoteDepEngine:
 
     # ------------------------------------------------------------ PTG path
     def ptg_send(self, tp, tc, pkey, flow_index: int, payload,
-                 ranks: Sequence[int]) -> None:
+                 ranks: Sequence[int], dtt: Optional[str] = None) -> None:
         """Ship a PTG task's output flow to the ranks hosting its remote
         successors (the remote activation of parsec_release_dep_fct); the
         receiver re-derives which local tasks it feeds from the replicated
         program (the phantom-task trick of remote_dep_get_datatypes,
-        remote_dep_mpi.c:861)."""
+        remote_dep_mpi.c:861). ``dtt`` names the datatype the payload was
+        pre-send reshaped to (one send per (flow, datatype) group)."""
         key = ("ptg", tp.name, tc.name, tuple(pkey) if isinstance(pkey, (list, tuple)) else pkey,
-               flow_index)
+               flow_index, dtt)
         if payload is not None and not hasattr(payload, "shape"):
             payload = np.asarray(payload)
         with self._lock:
@@ -276,8 +277,8 @@ class RemoteDepEngine:
         algo = mca.get("comm_coll_bcast", "chain")
         for child, subtree in bcast_children(ranks, self.ce.my_rank, algo):
             hdr = {"ptg": True, "tp": key[1], "tc": key[2], "pkey": key[3],
-                   "flow": key[4], "forward": subtree, "eager": True,
-                   "key": key, "version": 0}
+                   "flow": key[4], "dtt": key[5], "forward": subtree,
+                   "eager": True, "key": key, "version": 0}
             self.ce.send_am(TAG_REMOTE_DEP_ACTIVATE, child, hdr, payload)
             self.fourcounter.message_sent(tp)
 
@@ -464,7 +465,8 @@ class RemoteDepEngine:
         if tp is None:
             output.warning(f"PTG payload for unknown taskpool {hdr.get('tp')!r}")
             return
-        tp._ptg_data_arrived(hdr["tc"], hdr["pkey"], hdr["flow"], payload)
+        tp._ptg_data_arrived(hdr["tc"], hdr["pkey"], hdr["flow"], payload,
+                             wire_dtt=hdr.get("dtt"))
 
     # ------------------------------------------------------------ progress
     def progress(self) -> int:
@@ -615,7 +617,7 @@ class RemoteDepEngine:
             # taskpool name in the key) in one pass
             self._sent = {s for s in self._sent
                           if s[0] not in keys
-                          and not (isinstance(s[0], tuple) and len(s[0]) == 5
+                          and not (isinstance(s[0], tuple) and len(s[0]) >= 5
                                    and s[0][0] == "ptg" and s[0][1] == name)}
         # recycle arena recv buffers outside the lock: termination guarantees
         # no consumer, forward, or late expect can still reference them

@@ -446,7 +446,11 @@ class Context:
             return True
 
         for flow in tc.flows:
-            remote_ranks = set()
+            # remote destinations grouped by the out-dep's named datatype:
+            # each type is reshaped ONCE before the wire and packed once per
+            # destination set (pre-send remote reshape, parsec/remote_dep.h:117;
+            # remote_multiple_outs_same_pred_flow.jdf)
+            remote_by_dtt: Dict[Optional[str], set] = {}
             for dep in flow.deps_out:
                 if dep.cond is not None and not dep.cond(task.locals):
                     continue
@@ -461,17 +465,27 @@ class Context:
                         if r != self.my_rank:
                             # remote successor: ship this flow's output once
                             # per destination (the remote activation fork of
-                            # parsec_release_dep_fct)
-                            remote_ranks.add(r)
+                            # parsec_release_dep_fct); [type_remote]
+                            # overrides [type] on the wire
+                            wire = getattr(dep, "wire_datatype", dep.datatype)
+                            remote_by_dtt.setdefault(wire, set()).add(r)
                             continue
                     visit(dep, tl)
                     nb_uses += 1
-            if remote_ranks:
+            if remote_by_dtt:
                 slot = task.data[flow.flow_index]
                 out = slot.data_out if slot.data_out is not None else slot.data_in
                 payload = out.payload if hasattr(out, "payload") else out
-                self.comm.ptg_send(tp, tc, task.key, flow.flow_index,
-                                   payload, sorted(remote_ranks))
+                dtt_of = getattr(tp, "_dtt", None)
+                for dtt_name, ranks in remote_by_dtt.items():
+                    wire_payload = payload
+                    if dtt_name is not None and dtt_of is not None:
+                        dtt = dtt_of(dtt_name)
+                        if dtt is not None and not dtt.identity:
+                            wire_payload = dtt.extract(payload)
+                    self.comm.ptg_send(tp, tc, task.key, flow.flow_index,
+                                       wire_payload, sorted(ranks),
+                                       dtt=dtt_name)
         if entry is not None:
             repo.entry_addto_usage_limit(task.key, max(nb_uses, 1))
         # consume source repo entries (one use each)

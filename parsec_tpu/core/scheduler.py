@@ -188,6 +188,7 @@ class _LocalQueuesBase(SchedulerModule):
         self._order: List[int] = []
         self._system = _LockedDeque()
         self._init_lock = threading.Lock()
+        self._steal_cache: Dict[int, List[int]] = {}
 
     def _system_push(self, tasks: List[Task]) -> None:
         self._system.push_back(tasks)
@@ -198,8 +199,13 @@ class _LocalQueuesBase(SchedulerModule):
     def _steal_order(self, stream) -> List[int]:
         """Victims by increasing topological distance: ring order, same
         virtual process (NUMA-ish group) first — the hwloc-distance walk of
-        flow_*_init (sched_lfq_module.c / sched.h:210-335)."""
+        flow_*_init (sched_lfq_module.c / sched.h:210-335). Computed once
+        per stream (the stream set is fixed after Context init) — this
+        runs on every idle-spin select()."""
         me = stream.th_id
+        cached = self._steal_cache.get(me)
+        if cached is not None and len(cached) == len(self._order) - 1:
+            return cached
         n = len(self._order)
         if n <= 1:
             return []
@@ -208,6 +214,7 @@ class _LocalQueuesBase(SchedulerModule):
         my_vp = getattr(stream, "vp_id", 0)
         order.sort(key=lambda tid: 0 if
                    self.context.streams[tid].vp_id == my_vp else 1)
+        self._steal_cache[me] = order
         return order
 
     def stats(self, stream):

@@ -85,6 +85,66 @@ class ReshapeCache:
             self._promises.clear()
 
 
+class NamedDatatype:
+    """A named dep datatype: the (arena, datatype) pair a JDF dep carries
+    (ref: parsec_arena_datatype_t and the [type=...] dep annotations).
+
+    ``extract(arr)`` produces the typed view of a full tile (e.g. its lower
+    triangle); ``insert(dst, src)`` merges typed data back into a full tile
+    (the complement of dst is preserved). ``identity`` marks the DEFAULT
+    datatype: no conversion, consumers share the original copy (the
+    avoidable-reshape case, tests/collections/reshape/avoidable_reshape.jdf).
+    Hashable by name so one ReshapeCache promise is shared by every consumer
+    of (copy, datatype) — the single-copy guarantee of
+    input_dep_single_copy_reshape.jdf."""
+
+    __slots__ = ("name", "extract", "insert", "identity")
+
+    def __init__(self, name: str, extract: Optional[Callable] = None,
+                 insert: Optional[Callable] = None,
+                 identity: bool = False) -> None:
+        self.name = name
+        self.extract = extract if extract is not None else (lambda a: a)
+        self.insert = insert if insert is not None else (lambda dst, src: src)
+        self.identity = identity
+
+    def __hash__(self) -> int:
+        return hash(("NamedDatatype", self.name))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, NamedDatatype) and other.name == self.name
+
+    def __repr__(self) -> str:
+        return f"NamedDatatype({self.name!r})"
+
+    def convert(self, src_copy: DataCopy, _spec=None) -> DataCopy:
+        """ReshapeCache-compatible converter (spec == self)."""
+        out = DataCopy(src_copy.original, src_copy.device_index,
+                       self.extract(src_copy.payload), COHERENCY_SHARED)
+        out.version = src_copy.version
+        return out
+
+
+def lower_tile(dtype=None) -> NamedDatatype:
+    """The reference tests' LOWER_TILE: keep the (strictly including
+    diagonal) lower triangle, zero above."""
+    return NamedDatatype("LOWER_TILE",
+                         extract=lambda a: np.tril(np.asarray(a)),
+                         insert=lambda dst, src:
+                             np.triu(np.asarray(dst), 1) + np.tril(np.asarray(src)))
+
+
+def upper_tile(dtype=None) -> NamedDatatype:
+    return NamedDatatype("UPPER_TILE",
+                         extract=lambda a: np.triu(np.asarray(a)),
+                         insert=lambda dst, src:
+                             np.tril(np.asarray(dst), -1) + np.triu(np.asarray(src)))
+
+
+def default_datatype() -> NamedDatatype:
+    return NamedDatatype("DEFAULT", identity=True)
+
+
 def needs_reshape(copy: DataCopy, spec: ReshapeSpec) -> bool:
     x = copy.payload
     if spec.transpose:

@@ -36,7 +36,9 @@ from ...core.task import (
     Chore, DEV_CPU, DEV_TPU, Dep, Flow, FLOW_ACCESS_CTL, FLOW_ACCESS_READ,
     FLOW_ACCESS_RW, FLOW_ACCESS_WRITE, HOOK_DONE, Task, TaskClass, Taskpool,
 )
+from ...core.futures import DataCopyFuture
 from ...data.data import COHERENCY_OWNED, DataCopy
+from ...data.reshape import NamedDatatype, default_datatype
 from ...device.tpu import make_tpu_hook
 from ...utils import output
 from . import parser as P
@@ -107,10 +109,24 @@ class PTGTaskpool(Taskpool):
     def __init__(self, program: "PTGProgram", ctx: Context,
                  globals_: Dict[str, Any],
                  collections: Dict[str, Any],
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 datatypes: Optional[Dict[str, NamedDatatype]] = None) -> None:
         super().__init__(name or program.spec.name)
         self.program = program
         self.ctx = ctx
+        # named dep datatypes (the arenas_datatypes table of the generated
+        # taskpool, ref parsec_internal.h:42-47); DEFAULT is the identity
+        self.datatypes: Dict[str, NamedDatatype] = {"DEFAULT": default_datatype()}
+        self.datatypes.update(datatypes or {})
+        #: (id(source payload), dtt name) -> DataCopyFuture — the reshape
+        #: promise table: every consumer of (copy, datatype) shares ONE
+        #: conversion (ref: parsec_reshape.c repo entries;
+        #: input_dep_single_copy_reshape.jdf)
+        self._typed_cache: Dict[Tuple[int, str], DataCopyFuture] = {}
+        self._typed_lock = threading.Lock()
+        #: compiled out-dep tables per (producer class, flow) for the
+        #: guard-exact producer-datatype lookup
+        self._odt_cache: Dict[Tuple[str, str], List] = {}
         self.env_base: Dict[str, Any] = {"__builtins__": {}}
         self.env_base.update({
             "min": min, "max": max, "abs": abs, "range": range, "len": len,
@@ -192,9 +208,9 @@ class PTGTaskpool(Taskpool):
                 if d.direction != "in":
                     continue
                 guard = _Expr(d.guard) if d.guard else None
-                alts.append((guard, self._mk_ep(d.endpoint)))
+                alts.append((guard, self._mk_ep(d.endpoint, d.dtt)))
                 if d.else_endpoint is not None:
-                    alts.append(("else", self._mk_ep(d.else_endpoint)))
+                    alts.append(("else", self._mk_ep(d.else_endpoint, d.dtt)))
             in_specs.append(alts)
         tc._ptg_in_specs = in_specs
 
@@ -243,10 +259,12 @@ class PTGTaskpool(Taskpool):
             for d in fs.deps:
                 if d.direction != "out":
                     continue
-                self._add_out_dep(tc, flow, d.guard, d.endpoint)
+                self._add_out_dep(tc, flow, d.guard, d.endpoint, dtt=d.dtt,
+                                  dtt_remote=d.dtt_remote)
                 if d.else_endpoint is not None:
                     self._add_out_dep(tc, flow, d.guard, d.else_endpoint,
-                                      negate=True)
+                                      negate=True, dtt=d.dtt,
+                                      dtt_remote=d.dtt_remote)
 
         # hooks
         tc.prepare_input = self._mk_prepare_input(tc)
@@ -266,7 +284,8 @@ class PTGTaskpool(Taskpool):
                 tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn)))
             nb_bodies += 1
 
-    def _mk_ep(self, ep: Optional[P.Endpoint]) -> Optional[Dict[str, Any]]:
+    def _mk_ep(self, ep: Optional[P.Endpoint],
+               dtt: Optional[str] = None) -> Optional[Dict[str, Any]]:
         if ep is None:
             return None
         return {
@@ -274,10 +293,96 @@ class PTGTaskpool(Taskpool):
             "name": ep.name,
             "flow": ep.flow,
             "exprs": [_index_expr(e) for e in ep.index_exprs],
+            "dtt": dtt,
         }
 
+    # ------------------------------------------------------------- datatypes
+    def _dtt(self, name: Optional[str]) -> Optional[NamedDatatype]:
+        if name is None:
+            return None
+        d = self.datatypes.get(name)
+        if d is None:
+            output.fatal(f"PTG taskpool {self.name}: dep references unknown "
+                         f"datatype {name!r} (registered: "
+                         f"{sorted(self.datatypes)})")
+        return d
+
+    def _typed_payload(self, value: Any, dtt: Optional[NamedDatatype]) -> Any:
+        """Reshape-promise path (ref: parsec_get_copy_reshape_from_dep,
+        parsec_internal.h:688-696): the conversion runs lazily, ONCE, and is
+        shared by every consumer of (source copy, datatype). Identity
+        datatypes return the original untouched (avoidable_reshape.jdf)."""
+        if dtt is None or dtt.identity:
+            return value
+        payload = _payload_of(value)
+        key = (id(payload), dtt.name)
+        with self._typed_lock:
+            fut = self._typed_cache.get(key)
+            if fut is None:
+                src = value if isinstance(value, DataCopy) \
+                    else DataCopy(None, 0, payload)
+                fut = DataCopyFuture(src, dtt, lambda c, d: d.convert(c))
+                self._typed_cache[key] = fut
+        return fut.request()
+
+    def _out_dep_table(self, peer_name: str, peer_flow: str) -> List:
+        """Compiled (guard, [(which, class, flow, index_exprs)], dtt, wire)
+        rows for a producer flow's out-deps (compiled once per edge)."""
+        key = (peer_name, peer_flow)
+        tbl = self._odt_cache.get(key)
+        if tbl is None:
+            tbl = []
+            pf = self.program.spec.task_class(peer_name).flow(peer_flow)
+            for d in (pf.deps if pf is not None else []):
+                if d.direction != "out":
+                    continue
+                g = _Expr(d.guard) if d.guard else None
+                eps = {}
+                for which, ep in (("then", d.endpoint),
+                                  ("else", d.else_endpoint)):
+                    if ep is not None and ep.kind == "task":
+                        eps[which] = (ep.name, ep.flow,
+                                      [_index_expr(e) for e in ep.index_exprs])
+                wire = d.dtt_remote if d.dtt_remote is not None else d.dtt
+                tbl.append((g, eps, d.dtt, wire))
+            self._odt_cache[key] = tbl
+        return tbl
+
+    def _producer_out_dtt(self, peer_name: str, peer_flow: str,
+                          my_class: str, my_flow: str,
+                          plocals: Dict[str, int],
+                          my_key: Tuple[int, ...]
+                          ) -> Tuple[Optional[str], Optional[str]]:
+        """(local [type], wire type) the producer declared on the out-dep
+        that ACTUALLY feeds this task — guards evaluated under the
+        producer's locals and the fan-out index set checked against my key
+        (a flow may have several typed edges to the same class/flow behind
+        different guards)."""
+        env = self._env(plocals)
+        import itertools
+        for g, eps, dtt, wire in self._out_dep_table(peer_name, peer_flow):
+            which = "then"
+            if g is not None:
+                try:
+                    which = "then" if bool(g(env)) else "else"
+                except Exception:
+                    continue
+            ep = eps.get(which)
+            if ep is None or ep[0] != my_class or ep[1] != my_flow:
+                continue
+            try:
+                axes = [ex.values(env) for ex in ep[2]]
+                if tuple(my_key) not in set(itertools.product(*axes)):
+                    continue
+            except Exception:
+                pass   # unevaluable index: fall back to class/flow match
+            return dtt, wire
+        return None, None
+
     def _add_out_dep(self, tc: TaskClass, flow: Flow, guard: Optional[str],
-                     ep: P.Endpoint, negate: bool = False) -> None:
+                     ep: P.Endpoint, negate: bool = False,
+                     dtt: Optional[str] = None,
+                     dtt_remote: Optional[str] = None) -> None:
         gexpr = _Expr(guard) if guard else None
 
         def cond(loc, _g=gexpr, _n=negate):
@@ -300,18 +405,26 @@ class PTGTaskpool(Taskpool):
                 return [dict(zip(_params, combo))
                         for combo in itertools.product(*axes)]
 
-            flow.deps_out.append(Dep(
+            dep = Dep(
                 task_class=peer_tc, flow_index=peer_flow_idx,
                 dep_index=len(flow.deps_out), cond=cond,
-                target_locals=target_locals))
+                target_locals=target_locals,
+                datatype=dtt)        # named datatype (local reshape)
+            # [type_remote] overrides the wire datatype only — local
+            # successors keep the original copy (local_no_reshape.jdf)
+            dep.wire_datatype = dtt_remote if dtt_remote is not None else dtt
+            flow.deps_out.append(dep)
         elif ep.kind == "memory":
             exprs = [_Expr(e) for e in ep.index_exprs]
             flow._ptg_mem_out = getattr(flow, "_ptg_mem_out", [])
-            flow._ptg_mem_out.append((cond, ep.name, exprs))
+            flow._ptg_mem_out.append((cond, ep.name, exprs, dtt))
         # 'null' endpoints: data is dropped
 
     # ------------------------------------------------------------------ hooks
     def _mk_prepare_input(self, tc: TaskClass):
+        my_class = tc._ptg_spec.name
+        my_flows = [f.name for f in tc._ptg_spec.flows]
+
         def prepare_input(stream, task: Task) -> int:
             env = self._env(task.locals)
             for fi, flow in enumerate(tc.flows):
@@ -320,15 +433,21 @@ class PTGTaskpool(Taskpool):
                 if ep is None:
                     continue
                 slot = task.data[fi]
+                in_dtt = self._dtt(ep.get("dtt"))
                 if ep["kind"] == "memory":
                     dc = self.collections.get(ep["name"])
                     if dc is None:
                         output.fatal(f"unknown collection {ep['name']!r}")
                     data = dc.data_of(*[ex(env) for ex in ep["exprs"]])
                     copy = data.newest_copy()
-                    # unattached wrapper: body outputs never mutate the
-                    # collection implicitly (write-back is explicit out-deps)
-                    slot.data_in = DataCopy(None, 0, _payload_of(copy))
+                    if in_dtt is not None and not in_dtt.identity:
+                        # read-reshape: a NEW typed datacopy, shared by all
+                        # consumers of (copy, datatype) via the promise table
+                        slot.data_in = self._typed_payload(copy, in_dtt)
+                    else:
+                        # unattached wrapper: body outputs never mutate the
+                        # collection implicitly (write-back = explicit out-deps)
+                        slot.data_in = DataCopy(None, 0, _payload_of(copy))
                 elif ep["kind"] == "task":
                     peer = self._classes[ep["name"]]
                     peer_spec = self.program.spec.task_class(ep["name"])
@@ -336,23 +455,46 @@ class PTGTaskpool(Taskpool):
                     pf_idx = next(i for i, f in enumerate(peer_spec.flows)
                                   if f.name == ep["flow"])
                     plocals = dict(zip(peer_spec.params, pkey))
+                    out_dtt_name, wire_dtt_name = self._producer_out_dtt(
+                        ep["name"], ep["flow"], my_class, my_flows[fi],
+                        plocals, task.key)
                     if (self.ctx.nb_ranks > 1 and self.ctx.comm is not None
                             and self.task_rank_of(peer, plocals) != self.ctx.my_rank):
-                        # remote producer: payload was shipped by its rank
+                        # remote producer: payload was shipped by its rank,
+                        # ALREADY reshaped to the out-dep type before the
+                        # wire (pre-send reshape); never re-reshape with the
+                        # same type (remote_no_re_reshape.jdf). The arrival
+                        # is keyed by wire datatype so one flow fanning out
+                        # under several types delivers each shape intact
+                        # (remote_multiple_outs_same_pred_flow.jdf)
                         with self._ptg_lock:
-                            payload = self._ptg_received.get(
-                                (ep["name"], pkey, pf_idx))
-                        if payload is None:
+                            got = self._ptg_received.get(
+                                (ep["name"], pkey, pf_idx, wire_dtt_name))
+                        if got is None:
                             output.fatal(f"{task!r}: remote payload "
                                          f"{ep['name']}{pkey} missing")
-                        slot.data_in = DataCopy(None, 0, payload)
+                        payload, wire_dtt = got
+                        if in_dtt is not None and not in_dtt.identity \
+                                and in_dtt.name != wire_dtt:
+                            slot.data_in = self._typed_payload(payload, in_dtt)
+                        else:
+                            slot.data_in = DataCopy(None, 0, payload)
                         continue
                     repo = self.repos[peer.task_class_id]
                     entry = repo.lookup_entry(pkey)
                     if entry is None:
                         output.fatal(f"{task!r}: missing repo entry "
                                      f"{ep['name']}{pkey}")
-                    slot.data_in = entry.data[pf_idx]
+                    value = entry.data[pf_idx]
+                    # output-reshape (producer's [type]) then input-reshape
+                    # (this dep's [type]) when they differ; identical names
+                    # convert exactly once (avoidable_reshape.jdf)
+                    out_dtt = self._dtt(out_dtt_name)
+                    value = self._typed_payload(value, out_dtt)
+                    if in_dtt is not None and (out_dtt is None
+                                               or in_dtt.name != out_dtt.name):
+                        value = self._typed_payload(value, in_dtt)
+                    slot.data_in = value
                     slot.source_repo_entry = entry
                 elif ep["kind"] == "new":
                     slot.data_in = None
@@ -408,14 +550,21 @@ class PTGTaskpool(Taskpool):
                 value = slot.data_out if slot.data_out is not None else \
                     _payload_of(slot.data_in)
                 value = _payload_of(value)
-                for cond, dc_name, exprs in mem_outs:
+                for cond, dc_name, exprs, dtt_name in mem_outs:
                     if not cond(task.locals):
                         continue
                     dc = self.collections.get(dc_name)
                     data = dc.data_of(*[ex(env) for ex in exprs])
                     host = data.get_copy(0)
+                    dtt = self._dtt(dtt_name)
                     if host is None:
-                        data.create_copy(0, value, COHERENCY_OWNED)
+                        v = value if dtt is None or dtt.identity \
+                            else dtt.extract(value)
+                        data.create_copy(0, v, COHERENCY_OWNED)
+                    elif dtt is not None and not dtt.identity:
+                        # typed write-back merges only the datatype's region
+                        # into the tile; the complement is preserved
+                        host.payload = dtt.insert(host.payload, value)
                     else:
                         host.payload = value
                     data.bump_version(0)
@@ -461,14 +610,17 @@ class PTGTaskpool(Taskpool):
         return jax.jit(raw)
 
     def _ptg_data_arrived(self, tc_name: str, pkey, flow_index: int,
-                          payload) -> None:
+                          payload, wire_dtt: Optional[str] = None) -> None:
         """A remote producer's output landed here: credit every local
         successor it feeds, re-deriving them from the replicated program
         (the reference's phantom-task iterate-successors,
-        remote_dep_mpi.c:861)."""
+        remote_dep_mpi.c:861). ``wire_dtt`` names the datatype the payload
+        was reshaped to BEFORE the wire (pre-send reshape) so consumers
+        never re-reshape with the same type."""
         pkey = tuple(pkey) if isinstance(pkey, (list, tuple)) else (pkey,)
         with self._ptg_lock:
-            self._ptg_received[(tc_name, pkey, flow_index)] = payload
+            self._ptg_received[(tc_name, pkey, flow_index, wire_dtt)] = \
+                (payload, wire_dtt)
         tc = self._classes[tc_name]
         tcs = self.program.spec.task_class(tc_name)
         plocals = dict(zip(tcs.params, pkey))
@@ -476,6 +628,11 @@ class PTGTaskpool(Taskpool):
         ready = []
         flow = tc.flows[flow_index]
         for dep in flow.deps_out:
+            if getattr(dep, "wire_datatype", dep.datatype) != wire_dtt:
+                # each typed send credits exactly the successors on edges
+                # of its own wire datatype (one flow may fan out under
+                # several)
+                continue
             if dep.cond is not None and not dep.cond(plocals):
                 continue
             targets = dep.target_locals(plocals) if dep.target_locals else [plocals]
@@ -490,6 +647,16 @@ class PTGTaskpool(Taskpool):
                     ready.append(self.ctx.make_task(self, succ_tc, dict(tl)))
         if ready:
             self.ctx.schedule(ready)
+
+    def _declare_complete(self) -> None:
+        super()._declare_complete()
+        # retire the reshape-promise table and parked remote payloads: the
+        # graph is done, no consumer can request them again (the reference
+        # retires reshape promises with repo-entry refcounts)
+        with self._typed_lock:
+            self._typed_cache.clear()
+        with self._ptg_lock:
+            self._ptg_received.clear()
 
     # ------------------------------------------------------------------ startup
     def _enumerate(self):
@@ -540,9 +707,12 @@ class PTGProgram:
 
     def instantiate(self, ctx: Context, globals: Optional[Dict[str, Any]] = None,
                     collections: Optional[Dict[str, Any]] = None,
-                    name: Optional[str] = None) -> PTGTaskpool:
+                    name: Optional[str] = None,
+                    datatypes: Optional[Dict[str, NamedDatatype]] = None
+                    ) -> PTGTaskpool:
         return PTGTaskpool(self, ctx, dict(globals or {}),
-                           dict(collections or {}), name)
+                           dict(collections or {}), name,
+                           datatypes=datatypes)
 
 
 def compile_ptg(source: str, name: str = "ptg") -> PTGProgram:

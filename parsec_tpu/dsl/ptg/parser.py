@@ -74,6 +74,8 @@ class DepSpec:
     endpoint: Optional[Endpoint] = None
     else_endpoint: Optional[Endpoint] = None   # ternary alternative
     line_no: int = 0
+    dtt: Optional[str] = None          # [type = NAME] named datatype
+    dtt_remote: Optional[str] = None   # [type_remote = NAME] wire-only
 
 
 @dataclass
@@ -184,10 +186,31 @@ def _split_exprs(text: str) -> List[str]:
     return out
 
 
+_RE_DEP_ATTRS = re.compile(r"\[([^\]]*)\]\s*$")
+_RE_DEP_ATTR = re.compile(r"(\w+)\s*=\s*(\w+)")
+
+
 def _parse_dep(direction: str, text: str, line_no: int, line: str) -> DepSpec:
-    """Parse '(guard) ? EP : EP' | '(guard) ? EP' | 'EP'."""
+    """Parse '(guard) ? EP : EP' | '(guard) ? EP' | 'EP', with an optional
+    trailing attribute block '[type = NAME type_data = NAME]' (the JDF dep
+    datatype annotations, ref: jdf.h datatype properties)."""
     text = text.strip()
     dep = DepSpec(direction=direction, line_no=line_no)
+    am = _RE_DEP_ATTRS.search(text)
+    if am:
+        text = text[:am.start()].strip()
+        for key, val in _RE_DEP_ATTR.findall(am.group(1)):
+            if key in ("type", "type_data"):
+                if dep.dtt is not None and dep.dtt != val:
+                    raise PTGSyntaxError(
+                        f"conflicting type/type_data {dep.dtt!r} vs {val!r}",
+                        line_no, line)
+                dep.dtt = val
+            elif key == "type_remote":
+                dep.dtt_remote = val
+            else:
+                raise PTGSyntaxError(f"unknown dep attribute {key!r}",
+                                     line_no, line)
     if "?" in text:
         qpos = _top_level_find(text, "?")
         if qpos < 0:

@@ -1,0 +1,509 @@
+"""The reference's reshape scenario battery, re-done for this runtime.
+
+Analogues of /root/reference/tests/collections/reshape/ (13 scenarios across
+testing_reshape.c, testing_avoidable_reshape.c,
+testing_input_dep_reshape_single_copy.c,
+testing_remote_multiple_outs_same_pred_flow.c): named dep datatypes
+([type = NAME]) drive read/input/output reshapes through the shared
+reshape-promise engine (data/reshape.py + DataCopyFuture), typed memory
+write-back merges only the datatype's region, and remote deps reshape
+BEFORE the wire (pre-send, parsec/remote_dep.h:117) and never re-reshape
+at the receiver.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.remote_dep import RemoteDepEngine
+from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.data.reshape import NamedDatatype, lower_tile, upper_tile
+from parsec_tpu.dsl.ptg.compiler import compile_ptg
+
+M, TS = 8, 4   # 2x2 tiles of 4x4, like the reference's 8x8/4x4 default
+
+
+def _mk(name, val=1.0, nodes=1, rank=0, P=1):
+    dc = TwoDimBlockCyclic(name, M, M, TS, TS, P=P, Q=1,
+                           nodes=nodes, myrank=rank)
+    dc.fill(lambda m, n: np.full((TS, TS), val, np.float32))
+    return dc
+
+
+def _counting(base: NamedDatatype):
+    calls = {"extract": 0}
+
+    def ex(a, _b=base):
+        calls["extract"] += 1
+        return _b.extract(a)
+
+    return NamedDatatype(base.name, extract=ex, insert=base.insert), calls
+
+
+# the reference's 3-task chain: READ -> ZERO -> WRITE (local_*.jdf)
+def _chain_src(read_attr="", out_attr="", zero_in_attr="", write_attr=""):
+    return f"""
+%global descA
+
+READ_A(m, k)
+  m = 0 .. 1
+  k = 0 .. 1
+  : descA(m, k)
+  RW A <- descA(m, k)   {read_attr}
+       -> A SET_ZEROS(m, k)   {out_attr}
+BODY
+  A = A
+END
+
+SET_ZEROS(m, k)
+  m = 0 .. 1
+  k = 0 .. 1
+  : descA(m, k)
+  RW A <- A READ_A(m, k)   {zero_in_attr}
+       -> A WRITE_A(m, k)
+BODY
+  A = A * 0.0
+END
+
+WRITE_A(m, k)
+  m = 0 .. 1
+  k = 0 .. 1
+  : descA(m, k)
+  RW A <- A SET_ZEROS(m, k)
+       -> descA(m, k)   {write_attr}
+BODY
+  A = A
+END
+"""
+
+
+def _run_chain(src, datatypes=None):
+    ctx = Context(nb_cores=1)
+    A = _mk("descA")
+    tp = compile_ptg(src, "chain").instantiate(
+        ctx, collections={"descA": A}, datatypes=datatypes)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=30)
+    ctx.fini()
+    return A.to_dense(), tp
+
+
+def test_s1_local_no_reshape():
+    """No [type]: successors see the full tile; everything is zeroed
+    (local_no_reshape.jdf)."""
+    out, _ = _run_chain(_chain_src())
+    np.testing.assert_array_equal(out, np.zeros((M, M), np.float32))
+
+
+def test_s2_local_read_reshape():
+    """[type] when reading from the matrix: the zeroing hits a NEW lower
+    datacopy; typed write-back replaces only the lower region — the upper
+    part of the original survives (local_read_reshape.jdf)."""
+    out, _ = _run_chain(
+        _chain_src(read_attr="[type = LOWER_TILE]",
+                   write_attr="[type = LOWER_TILE]"),
+        datatypes={"LOWER_TILE": lower_tile()})
+    expect = np.kron(np.ones((2, 2), np.float32),
+                     np.triu(np.ones((TS, TS), np.float32), 1))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_s3_local_output_reshape():
+    """[type] on an output dep: the successor receives the reshaped copy
+    (local_output_reshape.jdf)."""
+    out, _ = _run_chain(
+        _chain_src(out_attr="[type = LOWER_TILE]",
+                   write_attr="[type = LOWER_TILE]"),
+        datatypes={"LOWER_TILE": lower_tile()})
+    expect = np.kron(np.ones((2, 2), np.float32),
+                     np.triu(np.ones((TS, TS), np.float32), 1))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_s4_local_input_reshape():
+    """[type] on an input dep: same result through the consumer-side
+    conversion (local_input_reshape.jdf)."""
+    out, _ = _run_chain(
+        _chain_src(zero_in_attr="[type = LOWER_TILE]",
+                   write_attr="[type = LOWER_TILE]"),
+        datatypes={"LOWER_TILE": lower_tile()})
+    expect = np.kron(np.ones((2, 2), np.float32),
+                     np.triu(np.ones((TS, TS), np.float32), 1))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_s5_typed_writeback_preserves_complement():
+    """Typed memory write-back merges ONLY the datatype's region; an
+    UPPER write leaves the strictly-lower region untouched."""
+    out, _ = _run_chain(
+        _chain_src(write_attr="[type = UPPER_TILE]"),
+        datatypes={"UPPER_TILE": upper_tile()})
+    # zeros written through UPPER: upper becomes 0, strict lower stays 1
+    expect = np.kron(np.ones((2, 2), np.float32),
+                     np.tril(np.ones((TS, TS), np.float32), -1))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_s6_avoidable_reshape_same_type_converts_once():
+    """Producer [type] == consumer [type]: ONE conversion, not two
+    (avoidable_reshape.jdf)."""
+    dtt, calls = _counting(lower_tile())
+    out, tp = _run_chain(
+        _chain_src(out_attr="[type = LOWER_TILE]",
+                   zero_in_attr="[type = LOWER_TILE]",
+                   write_attr="[type = LOWER_TILE]"),
+        datatypes={"LOWER_TILE": dtt})
+    # 4 tiles, one READ_A->SET_ZEROS conversion each + 0 re-conversions
+    assert calls["extract"] == 4, calls
+    expect = np.kron(np.ones((2, 2), np.float32),
+                     np.triu(np.ones((TS, TS), np.float32), 1))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_s7_default_type_is_identity():
+    """[type = DEFAULT] never converts: registered implicitly, identity
+    semantics (the adt_default of the reference harness)."""
+    out, tp = _run_chain(_chain_src(out_attr="[type = DEFAULT]",
+                                    write_attr="[type = DEFAULT]"))
+    np.testing.assert_array_equal(out, np.zeros((M, M), np.float32))
+    assert len(tp._typed_cache) == 0
+
+
+def test_s8_unknown_datatype_is_fatal():
+    """A dep referencing an unregistered datatype fails loudly."""
+    with pytest.raises(RuntimeError, match="unknown .*datatype"):
+        _run_chain(_chain_src(read_attr="[type = NO_SUCH]"))
+
+
+def test_s9_input_dep_single_copy():
+    """Two consumer tasks reading the same tile with the same [type] share
+    ONE converted copy (input_dep_single_copy_reshape.jdf)."""
+    dtt, calls = _counting(lower_tile())
+    src = """
+%global descA
+%global descB
+
+C(i, j)
+  i = 0 .. 1
+  j = 0 .. 1
+  : descB(i, j)
+  READ A <- descA(0, 0)    [type = LOWER_TILE]
+  RW   B <- descB(i, j)
+       -> descB(i, j)
+BODY
+  B = B + A
+END
+"""
+    ctx = Context(nb_cores=1)
+    A = _mk("descA")
+    B = _mk("descB", val=0.0)
+    tp = compile_ptg(src, "single").instantiate(
+        ctx, collections={"descA": A, "descB": B},
+        datatypes={"LOWER_TILE": dtt})
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=30)
+    ctx.fini()
+    assert calls["extract"] == 1, calls     # 4 consumers, ONE conversion
+    expect = np.kron(np.ones((2, 2), np.float32),
+                     np.tril(np.ones((TS, TS), np.float32)))
+    np.testing.assert_array_equal(B.to_dense(), expect)
+
+
+def test_s10_local_LU_LL_two_types():
+    """Producer ships LOWER, consumer asks UPPER: the conversions CHAIN
+    (local_input_LU_LL.jdf's two-datatype path). tril then triu leaves the
+    diagonal only."""
+    src = """
+%global descA
+%global descB
+
+P(m, k)
+  m = 0 .. 1
+  k = 0 .. 1
+  : descA(m, k)
+  RW A <- descA(m, k)
+       -> A C(m, k)        [type = LOWER_TILE]
+BODY
+  A = A
+END
+
+C(m, k)
+  m = 0 .. 1
+  k = 0 .. 1
+  : descB(m, k)
+  RW A <- A P(m, k)        [type = UPPER_TILE]
+       -> descB(m, k)
+BODY
+  A = A
+END
+"""
+    ctx = Context(nb_cores=1)
+    A = _mk("descA")
+    B = _mk("descB", val=0.0)
+    tp = compile_ptg(src, "lull").instantiate(
+        ctx, collections={"descA": A, "descB": B},
+        datatypes={"LOWER_TILE": lower_tile(), "UPPER_TILE": upper_tile()})
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=30)
+    ctx.fini()
+    expect = np.kron(np.ones((2, 2), np.float32),
+                     np.eye(TS, dtype=np.float32))
+    np.testing.assert_array_equal(B.to_dense(), expect)
+
+
+# ---------------------------------------------------------------- remote ----
+_REMOTE_SRC = """
+%global descA
+%global descB
+
+P(m)
+  m = 0 .. 1
+  : descA(m, 0)
+  RW A <- descA(m, 0)
+       -> A C(m)           [type = LOWER_TILE]
+BODY
+  A = A
+END
+
+C(m)
+  m = 0 .. 1
+  : descB(m, 0)
+  RW B <- descB(m, 0)
+       -> descB(m, 0)
+  READ A <- A P(m)         [type = LOWER_TILE]
+BODY
+  B = B + A
+END
+"""
+
+
+def _remote_program(dtt_factory):
+    """2 ranks: producers own descA (rank 0), consumers own descB (rank 1)."""
+    def program(rank, fabric):
+        ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        A = TwoDimBlockCyclic("descA", M, TS, TS, TS, P=1, Q=1,
+                              nodes=2, myrank=rank)       # all rank 0
+        B = TwoDimBlockCyclic("descB", M, TS, TS, TS, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        # force descB tiles onto rank 1 (rows 0,1 -> ranks 0,1; row 1 only?)
+        A.fill(lambda m, n: np.full((TS, TS), 1.0, np.float32))
+        B.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        dtt, calls = dtt_factory()
+        tp = compile_ptg(_REMOTE_SRC, "rrr").instantiate(
+            ctx, collections={"descA": A, "descB": B},
+            datatypes={"LOWER_TILE": dtt})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        ctx.fini()
+        mine = {m: np.asarray(B.data_of(m, 0).newest_copy().payload)
+                for m in range(2) if B.rank_of(m, 0) == rank}
+        return mine, calls["extract"]
+    return program
+
+
+def test_s11_remote_presend_reshape_no_re_reshape():
+    """Distributed: the payload is reshaped BEFORE the wire on the producer
+    rank; the consumer (same [type]) does NOT re-reshape
+    (remote_read_reshape.jdf + remote_no_re_reshape.jdf)."""
+    results = run_distributed(2, _remote_program(
+        lambda: _counting(lower_tile())), timeout=60)
+    tiles = {}
+    for mine, _ in results:
+        tiles.update(mine)
+    expect = np.tril(np.ones((TS, TS), np.float32))
+    for m in range(2):
+        np.testing.assert_array_equal(tiles[m], expect)
+    # rank 0 (producer side) converts once per cross-rank tile; rank 1
+    # (consumer side) must not convert at all for its remote input
+    extracts = [c for _, c in results]
+    assert extracts[0] >= 1
+    # rank 1 owns descB(1,0); its consumer C(1) is remote-fed and must not
+    # re-extract. C(0) runs on rank 0 (local path, may extract there).
+    assert extracts[1] == 0, extracts
+
+
+_MULTI_SRC = """
+%global descA
+%global descB
+%global descC
+
+P(m)
+  m = 0 .. 0
+  : descA(0, 0)
+  RW A <- descA(0, 0)
+       -> A CL(m)          [type = LOWER_TILE]
+       -> A CU(m)          [type = UPPER_TILE]
+BODY
+  A = A
+END
+
+CL(m)
+  m = 0 .. 0
+  : descB(1, 0)
+  RW B <- descB(1, 0)
+       -> descB(1, 0)
+  READ A <- A P(m)         [type = LOWER_TILE]
+BODY
+  B = B + A
+END
+
+CU(m)
+  m = 0 .. 0
+  : descC(1, 0)
+  RW C <- descC(1, 0)
+       -> descC(1, 0)
+  READ A <- A P(m)         [type = UPPER_TILE]
+BODY
+  C = C + A
+END
+"""
+
+
+def test_s12_s13_remote_multiple_outs_same_pred_flow():
+    """One producer flow fans out to remote consumers under TWO different
+    datatypes: each consumer receives its own shape, each type is packed/
+    sent once (remote_multiple_outs_same_pred_flow*.jdf)."""
+    def program(rank, fabric):
+        ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        A = TwoDimBlockCyclic("descA", TS, TS, TS, TS, P=1, Q=1,
+                              nodes=2, myrank=rank)       # rank 0
+        B = TwoDimBlockCyclic("descB", M, TS, TS, TS, P=2, Q=1,
+                              nodes=2, myrank=rank)       # row 1 -> rank 1
+        C = TwoDimBlockCyclic("descC", M, TS, TS, TS, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        A.fill(lambda m, n: np.full((TS, TS), 1.0, np.float32))
+        B.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        C.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        tp = compile_ptg(_MULTI_SRC, "multi").instantiate(
+            ctx, collections={"descA": A, "descB": B, "descC": C},
+            datatypes={"LOWER_TILE": lower_tile(),
+                       "UPPER_TILE": upper_tile()})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        ctx.fini()
+        if rank == 1:
+            return (np.asarray(B.data_of(1, 0).newest_copy().payload),
+                    np.asarray(C.data_of(1, 0).newest_copy().payload))
+        return None
+
+    results = run_distributed(2, program, timeout=60)
+    lower_got, upper_got = results[1]
+    np.testing.assert_array_equal(lower_got,
+                                  np.tril(np.ones((TS, TS), np.float32)))
+    np.testing.assert_array_equal(upper_got,
+                                  np.triu(np.ones((TS, TS), np.float32)))
+
+
+def test_s14_guarded_typed_edges_resolve_exactly():
+    """Two guarded out-deps to the same (class, flow), only one typed: the
+    datatype attaches to the edge that actually FIRES for each task
+    (regression: name-only matching reshaped C(1)'s input too)."""
+    src = """
+%global descA
+%global descB
+
+P(m)
+  m = 0 .. 1
+  : descA(m, 0)
+  RW A <- descA(m, 0)
+       -> (m == 0) ? A C(m)   [type = LOWER_TILE]
+       -> (m == 1) ? A C(m)
+BODY
+  A = A
+END
+
+C(m)
+  m = 0 .. 1
+  : descB(m, 0)
+  RW B <- descB(m, 0)
+       -> descB(m, 0)
+  READ A <- A P(m)
+BODY
+  B = B + A
+END
+"""
+    ctx = Context(nb_cores=1)
+    A = _mk("descA")
+    B = _mk("descB", val=0.0)
+    tp = compile_ptg(src, "guarded").instantiate(
+        ctx, collections={"descA": A, "descB": B},
+        datatypes={"LOWER_TILE": lower_tile()})
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=30)
+    ctx.fini()
+    got = B.to_dense()
+    ones, tril = np.ones((TS, TS), np.float32), \
+        np.tril(np.ones((TS, TS), np.float32))
+    np.testing.assert_array_equal(got[:TS, :TS], tril)   # C(0): typed edge
+    np.testing.assert_array_equal(got[TS:, :TS], ones)   # C(1): untyped edge
+
+
+def test_s15_type_remote_applies_on_wire_only():
+    """[type_remote]: the LOCAL successor keeps the full original copy
+    while the REMOTE successor receives the wire-typed payload
+    (local_no_reshape.jdf's type_remote semantics)."""
+    src = """
+%global descA
+%global descB
+%global descC
+
+P(m)
+  m = 0 .. 0
+  : descA(0, 0)
+  RW A <- descA(0, 0)
+       -> A CL(m)            [type_remote = LOWER_TILE]
+       -> A CR(m)            [type_remote = LOWER_TILE]
+BODY
+  A = A
+END
+
+CL(m)
+  m = 0 .. 0
+  : descB(0, 0)
+  RW B <- descB(0, 0)
+       -> descB(0, 0)
+  READ A <- A P(m)
+BODY
+  B = B + A
+END
+
+CR(m)
+  m = 0 .. 0
+  : descC(1, 0)
+  RW C <- descC(1, 0)
+       -> descC(1, 0)
+  READ A <- A P(m)
+BODY
+  C = C + A
+END
+"""
+    def program(rank, fabric):
+        ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        A = TwoDimBlockCyclic("descA", TS, TS, TS, TS, P=1, Q=1,
+                              nodes=2, myrank=rank)       # rank 0
+        B = TwoDimBlockCyclic("descB", TS, TS, TS, TS, P=1, Q=1,
+                              nodes=2, myrank=rank)       # rank 0 (local)
+        C = TwoDimBlockCyclic("descC", M, TS, TS, TS, P=2, Q=1,
+                              nodes=2, myrank=rank)       # row 1 -> rank 1
+        A.fill(lambda m, n: np.full((TS, TS), 1.0, np.float32))
+        B.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        C.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        tp = compile_ptg(src, "trem").instantiate(
+            ctx, collections={"descA": A, "descB": B, "descC": C},
+            datatypes={"LOWER_TILE": lower_tile()})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        ctx.fini()
+        if rank == 0:
+            return np.asarray(B.data_of(0, 0).newest_copy().payload)
+        return np.asarray(C.data_of(1, 0).newest_copy().payload)
+
+    results = run_distributed(2, program, timeout=60)
+    # local successor saw the FULL tile; remote got the wire-typed payload
+    np.testing.assert_array_equal(results[0], np.ones((TS, TS), np.float32))
+    np.testing.assert_array_equal(results[1],
+                                  np.tril(np.ones((TS, TS), np.float32)))
