@@ -59,6 +59,12 @@ def main() -> None:
     from parsec_tpu.dsl.dtd import DTDTaskpool
     from parsec_tpu.ops.gemm import gemm_flops, insert_gemm_tasks
 
+    if on_tpu:
+        # compile-only gate: a Mosaic lowering break on real hardware is a
+        # red bench, not a silent fall-back-to-XLA perf regression
+        from parsec_tpu.ops.pallas_kernels import verify_lowering
+        log(f"pallas lowering gate: {verify_lowering()}")
+
     N = 8192 if on_tpu else 2048
     TS = 1024 if on_tpu else 512
     reps = 3 if on_tpu else 2

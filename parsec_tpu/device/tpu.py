@@ -101,6 +101,7 @@ class TPUDevice(DeviceModule):
         self._prof_keys = None
         self._lru: "collections.OrderedDict[Any, DataCopy]" = collections.OrderedDict()
         self._lru_sizes: Dict[Any, int] = {}   # accounted bytes per key
+        self._lru_segs: Dict[Any, Any] = {}    # key -> pt_zone segment
         self._resident_bytes = 0
         budget = mca.get("device_tpu_max_bytes", 0)
         if not budget:
@@ -110,6 +111,16 @@ class TPUDevice(DeviceModule):
             except Exception:
                 budget = 0
         self._budget = budget or (12 << 30)
+        # the device heap ledger: every resident tile owns a pt_zone segment
+        # (offset + size), so occupancy/fragmentation are first-class stats
+        # (ref: the GPU zone_malloc heap, parsec/utils/zone_malloc.c; native
+        # allocator: native/src/ptcore.cpp pt_zone) — XLA still owns the
+        # physical bytes, the zone is the runtime's own accounting
+        from ..utils.zone_malloc import ZoneMalloc
+        # 64KB units keep the ledger granularity close to the byte-exact
+        # eviction accounting even for small tiles (a 1MB default unit would
+        # fill the zone ~100x faster than _resident_bytes and desync them)
+        self._zone = ZoneMalloc(self._budget, unit=65536)
 
     # ------------------------------------------------------------- dispatch API
     def kernel_scheduler(self, stream, task: Task, tpu_task: Optional[TPUTask] = None,
@@ -169,14 +180,20 @@ class TPUDevice(DeviceModule):
                 else:
                     submitted = group if self._submit_one_retry(gt) else []
                 self._inflight.extend(submitted)
-            # event polling + kernel_pop/epilog (device_gpu.c:2593,2944,3179)
+            # event polling + kernel_pop/epilog: poll each task's events
+            # independently — inflight tasks are mutually independent (their
+            # deps only release at epilog), so one slow kernel must not
+            # head-of-line block completed peers behind it (ref: per-stream
+            # event polls, device_gpu.c:2593,2944,3179)
+            still: Deque[TPUTask] = collections.deque()
             while self._inflight:
-                gt = self._inflight[0]
+                gt = self._inflight.popleft()
                 if gt.out_arrays and not all(a.is_ready() for a in gt.out_arrays):
-                    break  # in-order completion like stream events
-                self._inflight.popleft()
+                    still.append(gt)
+                    continue
                 self._epilog(stream, gt)
                 completed += 1
+            self._inflight = still
             return completed
         finally:
             self._manager_lock.release()
@@ -372,6 +389,16 @@ class TPUDevice(DeviceModule):
         self._resident_bytes += new_size - old_size
         self._lru_sizes[key] = new_size
         self._lru[key] = copy
+        if new_size != old_size or key not in self._lru_segs:
+            # re-register on size change AND whenever the key has no live
+            # segment (a past allocate() miss under pressure must not
+            # permanently drop the tile from the ledger)
+            seg = self._lru_segs.pop(key, None)
+            if seg is not None:
+                seg.free()
+            seg = self._zone.allocate(new_size)
+            if seg is not None:
+                self._lru_segs[key] = seg
 
     def evict_bytes(self, nbytes: int) -> int:
         """Force eviction of about ``nbytes`` of resident clean/dirty copies
@@ -391,6 +418,9 @@ class TPUDevice(DeviceModule):
                     self._stage_out(data, copy)
                 self._lru.pop(key)
                 self._resident_bytes -= self._lru_sizes.pop(key, 0)
+                seg = self._lru_segs.pop(key, None)
+                if seg is not None:
+                    seg.free()
                 copy.coherency_state = COHERENCY_INVALID
                 copy.payload = None
                 break
@@ -413,6 +443,9 @@ class TPUDevice(DeviceModule):
                     self._stage_out(data, copy)   # dirty: write back first
                 self._lru.pop(key)
                 self._resident_bytes -= self._lru_sizes.pop(key, 0)
+                seg = self._lru_segs.pop(key, None)
+                if seg is not None:
+                    seg.free()
                 copy.coherency_state = COHERENCY_INVALID
                 copy.payload = None
                 evicted = True
@@ -420,9 +453,29 @@ class TPUDevice(DeviceModule):
             if not evicted:
                 break  # everything pinned; rely on XLA allocator
 
+    def zone_stats(self) -> Dict[str, int]:
+        """Device-heap ledger stats (occupancy, fragmentation, high-water
+        mark) — the zonemalloc_benchmark surface of the reference."""
+        return self._zone.stats()
+
+    def set_budget(self, nbytes: int, unit: Optional[int] = None) -> None:
+        """Resize the HBM tile budget (tests / MCA reconfiguration): the
+        zone ledger is rebuilt and current residents re-registered."""
+        from ..utils.zone_malloc import ZoneMalloc
+        self._budget = nbytes
+        self._zone = ZoneMalloc(nbytes, unit)
+        self._lru_segs = {}
+        for key, sz in self._lru_sizes.items():
+            seg = self._zone.allocate(sz)
+            if seg is not None:
+                self._lru_segs[key] = seg
+
     def fini(self) -> None:
         self._lru.clear()
         self._lru_sizes.clear()
+        for seg in self._lru_segs.values():
+            seg.free()
+        self._lru_segs.clear()
         self._resident_bytes = 0
         self._pending.clear()
 

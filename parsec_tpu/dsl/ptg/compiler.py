@@ -361,21 +361,18 @@ class PTGTaskpool(Taskpool):
         env = self._env(plocals)
         import itertools
         for g, eps, dtt, wire in self._out_dep_table(peer_name, peer_flow):
+            # guard/index exceptions propagate: the sender side evaluates
+            # the same expressions (dep.cond / target_locals) and lets them
+            # raise, and the two ends of a remote edge must agree
             which = "then"
             if g is not None:
-                try:
-                    which = "then" if bool(g(env)) else "else"
-                except Exception:
-                    continue
+                which = "then" if bool(g(env)) else "else"
             ep = eps.get(which)
             if ep is None or ep[0] != my_class or ep[1] != my_flow:
                 continue
-            try:
-                axes = [ex.values(env) for ex in ep[2]]
-                if tuple(my_key) not in set(itertools.product(*axes)):
-                    continue
-            except Exception:
-                pass   # unevaluable index: fall back to class/flow match
+            axes = [ex.values(env) for ex in ep[2]]
+            if tuple(my_key) not in set(itertools.product(*axes)):
+                continue
             return dtt, wire
         return None, None
 

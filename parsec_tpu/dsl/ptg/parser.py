@@ -199,6 +199,10 @@ def _parse_dep(direction: str, text: str, line_no: int, line: str) -> DepSpec:
     am = _RE_DEP_ATTRS.search(text)
     if am:
         text = text[:am.start()].strip()
+        if not re.fullmatch(r"(?:\s*\w+\s*=\s*\w+\s*)*", am.group(1)):
+            raise PTGSyntaxError(
+                f"malformed dep attribute block [{am.group(1)}] "
+                f"(expected 'key = NAME' pairs)", line_no, line)
         for key, val in _RE_DEP_ATTR.findall(am.group(1)):
             if key in ("type", "type_data"):
                 if dep.dtt is not None and dep.dtt != val:

@@ -25,6 +25,12 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..utils import mca
+
+mca.register("pallas_strict", False,
+             "Fail loudly instead of falling back to XLA when a Pallas "
+             "kernel cannot lower/run (the CI compile gate)", type=bool)
+
 
 def _backend() -> str:
     import jax
@@ -36,6 +42,72 @@ def _backend() -> str:
 
 def _interpret() -> bool:
     return _backend() not in ("tpu",)
+
+
+_warned_fallbacks: set = set()
+
+
+def _fallback(kernel_name: str, err: Exception) -> None:
+    """A Pallas failure must never be invisible: strict mode re-raises
+    (the CI compile gate), default mode warns ONCE per kernel before the
+    XLA fallback runs."""
+    from ..utils import mca, output
+    if mca.get("pallas_strict", False):
+        raise RuntimeError(
+            f"pallas kernel {kernel_name!r} failed to lower/run "
+            f"(pallas_strict=1): {err}") from err
+    if kernel_name not in _warned_fallbacks:
+        _warned_fallbacks.add(kernel_name)
+        output.warning(f"pallas kernel {kernel_name!r} fell back to XLA: "
+                       f"{type(err).__name__}: {err}")
+
+
+def verify_lowering(shapes=((256, 256, 256), ), kt: int = 4) -> dict:
+    """Compile-only gate: lower every kernel for the CURRENT backend (real
+    Mosaic lowering on TPU, interpreter elsewhere) and FAIL LOUDLY on any
+    error instead of silently falling back. Returns {kernel: 'ok'|error}.
+
+    Run under pallas_strict in CI / at bench startup so a Mosaic breakage
+    on real hardware is a red build, not a quiet perf regression."""
+    import jax
+    import numpy as np
+    results = {}
+    interp = _interpret()
+    errors = []
+    f32 = np.float32
+    for m, k, n in shapes:
+        checks = {
+            f"gemm_chain[{m}x{k}x{n}]": (
+                lambda m=m, k=k, n=n: _gemm_chain_call(
+                    kt, m, k, n, "float32", interp),
+                (jax.ShapeDtypeStruct((m, n), f32),
+                 jax.ShapeDtypeStruct((kt, m, k), f32),
+                 jax.ShapeDtypeStruct((kt, k, n), f32))),
+            f"matmul[{m}x{k}x{n}]": (
+                lambda m=m, k=k, n=n: _matmul_call(
+                    m, n, k, min(m, 256), min(n, 256), min(k, 256),
+                    "float32", interp),
+                (jax.ShapeDtypeStruct((m, k), f32),
+                 jax.ShapeDtypeStruct((k, n), f32))),
+            f"stencil1d[{n}]": (
+                lambda n=n: _stencil_call(
+                    8, n, (0.25, 0.5, 0.25), "float32", interp),
+                (jax.ShapeDtypeStruct((8, n), f32),
+                 jax.ShapeDtypeStruct((8, n), f32),
+                 jax.ShapeDtypeStruct((8, n), f32))),
+        }
+        for name, (build, args) in checks.items():
+            try:
+                # lower+compile without executing (the compile-only part)
+                jax.jit(build()).lower(*args).compile()
+                results[name] = "ok"
+            except Exception as e:  # noqa: BLE001 - collected and re-raised
+                results[name] = f"{type(e).__name__}: {e}"
+                errors.append(name)
+    if errors:
+        raise RuntimeError(f"pallas lowering FAILED for {errors}: "
+                           f"{ {k: results[k] for k in errors} }")
+    return results
 
 
 # ---------------------------------------------------------------------------
@@ -84,7 +156,8 @@ def gemm_chain(c, a_stack, b_stack):
     try:
         call = _gemm_chain_call(kt, ts_m, ts_k, ts_n, str(c.dtype), _interpret())
         return call(c, a_stack, b_stack)
-    except Exception:
+    except Exception as e:  # noqa: BLE001
+        _fallback("gemm_chain", e)
         # XLA fallback: scan keeps the accumulator in registers too
         import jax
 
@@ -143,7 +216,8 @@ def matmul(a, b, block: Tuple[int, int, int] = (256, 256, 256)):
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
     try:
         return _matmul_call(m, n, k, bm, bn, bk, str(a.dtype), _interpret())(a, b)
-    except Exception:
+    except Exception as e:  # noqa: BLE001
+        _fallback("matmul", e)
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
 
 
@@ -181,7 +255,8 @@ def stencil1d(x, left, right, weights=(0.25, 0.5, 0.25)):
         call = _stencil_call(x.shape[0], x.shape[1], tuple(weights),
                              str(x.dtype), _interpret())
         return call(x, left, right)
-    except Exception:
+    except Exception as e:  # noqa: BLE001
+        _fallback("stencil1d", e)
         import jax.numpy as jnp
         w0, w1, w2 = weights
         xm = jnp.concatenate([left[:, -1:], x[:, :-1]], axis=1)

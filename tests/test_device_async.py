@@ -92,9 +92,11 @@ def test_batched_dispatch(dctx):
 
 
 def test_eviction_under_pressure(dctx):
-    """A tiny HBM budget forces LRU eviction with write-back."""
+    """A tiny HBM budget forces LRU eviction with write-back; the pt_zone
+    ledger (offsets + occupancy stats) tracks every resident tile."""
     dev = _tpu_dev(dctx)
-    dev._budget = 3 * 16 * 16 * 4              # room for ~3 tiles
+    tile_b = 16 * 16 * 4
+    dev.set_budget(3 * tile_b, unit=tile_b)    # room for ~3 tiles
     A = TiledMatrix("AE", 16 * 8, 16, 16, 16)
     A.fill(lambda m, n: np.full((16, 16), float(m), np.float32))
     tp = DTDTaskpool(dctx, "evict")
@@ -104,7 +106,14 @@ def test_eviction_under_pressure(dctx):
     for m in range(8):
         assert np.allclose(np.asarray(A.data_of(m, 0).newest_copy().payload),
                            m + 0.5)
-    assert dev._resident_bytes <= dev._budget + 16 * 16 * 4
+    assert dev._resident_bytes <= dev._budget + tile_b
+    # the zone ledger: one live segment per resident tile, occupancy within
+    # budget, eviction churn visible in the high-water mark
+    zs = dev.zone_stats()
+    assert len(dev._lru_segs) == len(dev._lru)
+    assert zs["in_use_bytes"] == len(dev._lru_segs) * tile_b
+    assert zs["in_use_bytes"] <= zs["total_bytes"]
+    assert zs["hwm_bytes"] >= zs["in_use_bytes"] > 0
 
 
 def test_ptg_body_through_device_module(dctx):

@@ -53,3 +53,40 @@ def test_stencil_kernel_with_halos():
     xp = np.concatenate([x[:, 1:], r[:, :1]], axis=1)
     np.testing.assert_allclose(out, 0.25 * xm + 0.5 * x + 0.25 * xp,
                                rtol=1e-5, atol=1e-5)
+
+
+def test_verify_lowering_gate():
+    """The compile-only gate lowers every kernel for the current backend and
+    returns ok for all (it RAISES on a lowering break instead of silently
+    falling back — run with pallas_strict on real TPU CI)."""
+    from parsec_tpu.ops.pallas_kernels import verify_lowering
+    results = verify_lowering(shapes=((128, 128, 128),), kt=2)
+    assert all(v == "ok" for v in results.values()), results
+
+
+def test_pallas_strict_raises_instead_of_fallback(monkeypatch):
+    """pallas_strict=1 turns the silent XLA fallback into a hard error;
+    without it the fallback still runs (and warns once)."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+    from parsec_tpu.ops import pallas_kernels as pk
+    from parsec_tpu.utils import mca
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic lowering exploded")
+
+    monkeypatch.setattr(pk, "_gemm_chain_call", boom)
+    c = jnp.zeros((8, 8), jnp.float32)
+    a = jnp.ones((2, 8, 8), jnp.float32)
+    b = jnp.ones((2, 8, 8), jnp.float32)
+
+    mca.set("pallas_strict", True)
+    try:
+        with _pytest.raises(RuntimeError, match="pallas_strict"):
+            pk.gemm_chain(c, a, b)
+    finally:
+        mca.params.unset("pallas_strict")
+    # non-strict: the XLA fallback still computes the right answer
+    out = pk.gemm_chain(c, a, b)
+    import numpy as np
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 8), 16.0))
