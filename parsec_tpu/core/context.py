@@ -477,13 +477,15 @@ class Context:
                 out = slot.data_out if slot.data_out is not None else slot.data_in
                 payload = out.payload if hasattr(out, "payload") else out
                 dtt_of = getattr(tp, "_dtt", None)
+                ck = getattr(tc, "_ptg_canonical_key", None)
+                wire_key = ck(task) if ck is not None else task.key
                 for dtt_name, ranks in remote_by_dtt.items():
                     wire_payload = payload
                     if dtt_name is not None and dtt_of is not None:
                         dtt = dtt_of(dtt_name)
                         if dtt is not None and not dtt.identity:
                             wire_payload = dtt.extract(payload)
-                    self.comm.ptg_send(tp, tc, task.key, flow.flow_index,
+                    self.comm.ptg_send(tp, tc, wire_key, flow.flow_index,
                                        wire_payload, sorted(ranks),
                                        dtt=dtt_name)
         if entry is not None:

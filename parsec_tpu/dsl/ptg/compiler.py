@@ -161,6 +161,12 @@ class PTGTaskpool(Taskpool):
             tc.make_key = (lambda params: (
                 lambda tp, loc: tuple(loc[p] for p in params)
             ))(tcs.params)
+            # the wire always carries the canonical parameter tuple, even
+            # when make_key_fn customizes the local hash key (the receiving
+            # rank re-derives locals from it)
+            tc._ptg_canonical_key = (lambda params: (
+                lambda task: tuple(task.locals[p] for p in params)
+            ))(tcs.params)
             self.add_task_class(tc)
             self.repos[tc.task_class_id] = DataRepo(tc.nb_flows, tcs.name)
             self._classes[tcs.name] = tc
@@ -184,6 +190,23 @@ class PTGTaskpool(Taskpool):
         ranges.sort(key=lambda r: order[r[0]])
         tc._ptg_ranges = ranges
         tc._ptg_spec = tcs
+        # header property block (ref: udf.jdf user-defined functions):
+        # names resolve against the taskpool globals at instantiate time
+        mk_fn = self._resolve_callable(tcs, "make_key_fn",
+                                       tcs.header_props.get("make_key_fn"))
+        if mk_fn is not None:
+            # user-defined task key (ref: udf.jdf ud_make_key): fn(tp,
+            # locals) -> hashable key used by the dep repo/hash tables
+            tc.make_key = mk_fn
+        te_fn = self._resolve_callable(tcs, "time_estimate",
+                                       tcs.header_props.get("time_estimate"))
+        if te_fn is not None:
+            # feeds best-device selection (ref: parsec_internal.h:431-458
+            # time_estimate; consumed by DeviceRegistry.select_best_device)
+            tc.time_estimate = te_fn
+        tc._ptg_startup_fn = self._resolve_callable(
+            tcs, "startup_fn", tcs.header_props.get("startup_fn"))
+
         if tcs.priority_expr:
             prio = _Expr(tcs.priority_expr)
             tc.properties["priority"] = lambda loc, _p=prio: int(_p(self._env(loc)))
@@ -274,15 +297,32 @@ class PTGTaskpool(Taskpool):
             fn = self._compile_body(tcs, body)
             if nb_bodies == 0:
                 tc._ptg_body_fn = fn    # cross-DSL replay (pins ptg_to_dtd)
+            # [evaluate = fn]: per-incarnation gate (ref: udf.jdf evaluate
+            # properties selecting the chore); fn(stream, task) -> HOOK_*
+            evaluate = self._resolve_callable(tcs, "evaluate", body.evaluate)
             if body.device == "TPU":
                 tc.add_chore(Chore(DEV_TPU, make_tpu_hook(
-                    self._mk_tpu_submit(tc, fn))))
+                    self._mk_tpu_submit(tc, fn)), evaluate=evaluate))
                 # TPU bodies also serve as host chores through the same
                 # jitted function (degrades to the CPU backend off-pod)
-                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn)))
+                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn),
+                                   evaluate=evaluate))
             else:
-                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn)))
+                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn),
+                                   evaluate=evaluate))
             nb_bodies += 1
+
+    def _resolve_callable(self, tcs: P.TaskClassSpec, prop: str,
+                          name: Optional[str]):
+        """Resolve a user-function property name against the taskpool
+        globals; fatal when it does not name a callable."""
+        if name is None:
+            return None
+        fn = self.env_base.get(name)
+        if not callable(fn):
+            output.fatal(f"{tcs.name}: property {prop}={name!r} does not "
+                         f"name a callable in the taskpool globals")
+        return fn
 
     def _mk_ep(self, ep: Optional[P.Endpoint],
                dtt: Optional[str] = None) -> Optional[Dict[str, Any]]:
@@ -424,6 +464,9 @@ class PTGTaskpool(Taskpool):
 
         def prepare_input(stream, task: Task) -> int:
             env = self._env(task.locals)
+            # datatype resolution always compares CANONICAL parameter
+            # tuples, independent of any user make_key_fn hash key
+            canonical_key = tuple(task.locals[p] for p in tc._ptg_spec.params)
             for fi, flow in enumerate(tc.flows):
                 alts = tc._ptg_in_specs[fi]
                 ep = tc._ptg_active_in(alts, env)
@@ -454,7 +497,7 @@ class PTGTaskpool(Taskpool):
                     plocals = dict(zip(peer_spec.params, pkey))
                     out_dtt_name, wire_dtt_name = self._producer_out_dtt(
                         ep["name"], ep["flow"], my_class, my_flows[fi],
-                        plocals, task.key)
+                        plocals, canonical_key)
                     if (self.ctx.nb_ranks > 1 and self.ctx.comm is not None
                             and self.task_rank_of(peer, plocals) != self.ctx.my_rank):
                         # remote producer: payload was shipped by its rank,
@@ -478,7 +521,9 @@ class PTGTaskpool(Taskpool):
                             slot.data_in = DataCopy(None, 0, payload)
                         continue
                     repo = self.repos[peer.task_class_id]
-                    entry = repo.lookup_entry(pkey)
+                    # repo entries are stored under the producer's task key,
+                    # which may come from a user make_key_fn
+                    entry = repo.lookup_entry(peer.make_key(self, plocals))
                     if entry is None:
                         output.fatal(f"{task!r}: missing repo entry "
                                      f"{ep['name']}{pkey}")
@@ -688,7 +733,21 @@ class PTGTaskpool(Taskpool):
             if distributed and tc._ptg_rank_of(loc) != my_rank:
                 continue
             total += 1
+            if getattr(tc, "_ptg_startup_fn", None) is not None:
+                continue    # custom startup seeds this class below
             if tc.dependencies_goal_fn(loc) == 0:
+                ready.append(self.ctx.make_task(self, tc, loc))
+        # user-defined startup (ref: udf.jdf startup_fn): fn(taskpool,
+        # task_class) yields the locals of this class's initial ready tasks
+        for tcs in self.program.spec.task_classes:
+            tc = self._classes[tcs.name]
+            fn = getattr(tc, "_ptg_startup_fn", None)
+            if fn is None:
+                continue
+            for loc in fn(self, tc):
+                loc = dict(loc)
+                if distributed and tc._ptg_rank_of(loc) != my_rank:
+                    continue
                 ready.append(self.ctx.make_task(self, tc, loc))
         self.set_nb_tasks(total)
         output.debug_verbose(2, "ptg",

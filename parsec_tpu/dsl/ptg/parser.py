@@ -98,12 +98,17 @@ class BodySpec:
     device: str = "CPU"           # CPU | TPU
     source: str = ""
     line_no: int = 0
+    evaluate: Optional[str] = None   # [evaluate = fn]: chore gate, resolved
+                                     # from taskpool globals
 
 
 @dataclass
 class TaskClassSpec:
     name: str
     params: List[str]
+    #: header property block ``NAME(m, n) [ make_key_fn = f ... ]``
+    #: (ref: udf.jdf make_key_fn/startup_fn/time_estimate properties)
+    header_props: Dict[str, str] = field(default_factory=dict)
     ranges: List[RangeSpec] = field(default_factory=list)
     affinity: Optional[Endpoint] = None
     priority_expr: Optional[str] = None
@@ -133,11 +138,11 @@ class ProgramSpec:
 
 _RE_GLOBAL = re.compile(r"^%global\s+(\w+)\s*$")
 _RE_OPTION = re.compile(r"^%option\s+(\w+)\s*=\s*(\S+)\s*$")
-_RE_HEADER = re.compile(r"^(\w+)\s*\(\s*([\w\s,]*)\)\s*$")
+_RE_HEADER = re.compile(r"^(\w+)\s*\(\s*([\w\s,]*)\)\s*(?:\[([^\]]*)\])?\s*$")
 _RE_RANGE = re.compile(r"^(\w+)\s*=\s*(.+?)\s*\.\.\s*(.+?)(?:\s*\.\.\s*(.+?))?\s*$")
 _RE_AFFINITY = re.compile(r"^:\s*(\w+)\s*\(([^)]*)\)\s*$")
 _RE_PROPERTY = re.compile(r"^(\w+)\s*=\s*(.+)$")
-_RE_BODY = re.compile(r"^BODY(?:\s*\[\s*type\s*=\s*(\w+)\s*\])?\s*$")
+_RE_BODY = re.compile(r"^BODY(?:\s*\[([^\]]*)\])?\s*$")
 _RE_ENDPOINT_TASK = re.compile(r"^(\w+)\s+(\w+)\s*\(([^)]*)\)\s*$")
 _RE_ENDPOINT_MEM = re.compile(r"^(\w+)\s*\(([^)]*)\)\s*$")
 
@@ -190,6 +195,23 @@ _RE_DEP_ATTRS = re.compile(r"\[([^\]]*)\]\s*$")
 _RE_DEP_ATTR = re.compile(r"(\w+)\s*=\s*(\w+)")
 
 
+def _parse_attr_block(body: str, allowed, what: str, line_no: int,
+                      line: str) -> Dict[str, str]:
+    """Shared '[key = NAME ...]' attribute grammar (deps, BODY, task
+    headers). Malformed blocks and unknown keys are parse errors — a
+    silently-dropped attribute is wrong results later."""
+    if not re.fullmatch(r"(?:\s*\w+\s*=\s*\w+\s*)*", body):
+        raise PTGSyntaxError(
+            f"malformed {what} attribute block [{body}] "
+            f"(expected 'key = NAME' pairs)", line_no, line)
+    attrs = dict(_RE_DEP_ATTR.findall(body))
+    for k in attrs:
+        if k not in allowed:
+            raise PTGSyntaxError(f"unknown {what} attribute {k!r}",
+                                 line_no, line)
+    return attrs
+
+
 def _parse_dep(direction: str, text: str, line_no: int, line: str) -> DepSpec:
     """Parse '(guard) ? EP : EP' | '(guard) ? EP' | 'EP', with an optional
     trailing attribute block '[type = NAME type_data = NAME]' (the JDF dep
@@ -199,22 +221,15 @@ def _parse_dep(direction: str, text: str, line_no: int, line: str) -> DepSpec:
     am = _RE_DEP_ATTRS.search(text)
     if am:
         text = text[:am.start()].strip()
-        if not re.fullmatch(r"(?:\s*\w+\s*=\s*\w+\s*)*", am.group(1)):
+        attrs = _parse_attr_block(am.group(1),
+                                  ("type", "type_data", "type_remote"),
+                                  "dep", line_no, line)
+        t, td = attrs.get("type"), attrs.get("type_data")
+        if t is not None and td is not None and t != td:
             raise PTGSyntaxError(
-                f"malformed dep attribute block [{am.group(1)}] "
-                f"(expected 'key = NAME' pairs)", line_no, line)
-        for key, val in _RE_DEP_ATTR.findall(am.group(1)):
-            if key in ("type", "type_data"):
-                if dep.dtt is not None and dep.dtt != val:
-                    raise PTGSyntaxError(
-                        f"conflicting type/type_data {dep.dtt!r} vs {val!r}",
-                        line_no, line)
-                dep.dtt = val
-            elif key == "type_remote":
-                dep.dtt_remote = val
-            else:
-                raise PTGSyntaxError(f"unknown dep attribute {key!r}",
-                                     line_no, line)
+                f"conflicting type/type_data {t!r} vs {td!r}", line_no, line)
+        dep.dtt = t if t is not None else td
+        dep.dtt_remote = attrs.get("type_remote")
     if "?" in text:
         qpos = _top_level_find(text, "?")
         if qpos < 0:
@@ -279,7 +294,12 @@ def parse(source: str, name: str = "ptg") -> ProgramSpec:
         if m:
             if cur is None:
                 raise err("BODY outside a task class")
-            device = (m.group(1) or "CPU").upper()
+            device, evaluate = "CPU", None
+            if m.group(1):
+                attrs = _parse_attr_block(m.group(1), ("type", "evaluate"),
+                                          "BODY", i + 1, raw)
+                device = attrs.get("type", "CPU").upper()
+                evaluate = attrs.get("evaluate")
             if device not in ("CPU", "TPU"):
                 raise err(f"unknown body device type {device!r}")
             body_lines: List[str] = []
@@ -292,7 +312,7 @@ def parse(source: str, name: str = "ptg") -> ProgramSpec:
                 raise err("BODY without END")
             cur.bodies.append(BodySpec(device=device,
                                        source="\n".join(body_lines),
-                                       line_no=start))
+                                       line_no=start, evaluate=evaluate))
             cur_flow = None
             i += 1
             continue
@@ -341,13 +361,20 @@ def parse(source: str, name: str = "ptg") -> ProgramSpec:
             continue
         m = _RE_HEADER.match(line)
         if m and (cur is None or cur.bodies or not cur.params or True):
-            # a new task class header
+            # a new task class header, optionally with a property block
+            # (ref: udf.jdf '[ make_key_fn = ud_make_key ]')
             params = [p.strip() for p in m.group(2).split(",") if p.strip()]
             if len(params) != len(set(params)):
                 raise err(f"duplicate parameter names in {m.group(1)}")
             if len(params) > MAX_LOCAL_COUNT:
                 raise err(f"too many task parameters (max {MAX_LOCAL_COUNT})")
-            cur = TaskClassSpec(name=m.group(1), params=params)
+            props: Dict[str, str] = {}
+            if m.group(3):
+                props = _parse_attr_block(
+                    m.group(3), ("make_key_fn", "startup_fn", "time_estimate"),
+                    "task-class", i + 1, raw)
+            cur = TaskClassSpec(name=m.group(1), params=params,
+                                header_props=props)
             prog.task_classes.append(cur)
             cur_flow = None
             i += 1
