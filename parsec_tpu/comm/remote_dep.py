@@ -38,8 +38,8 @@ import numpy as np
 
 from ..core import termdet as termdet_mod
 from ..utils import mca, output
-from .engine import (CommEngine, TAG_INTERNAL_GET, TAG_INTERNAL_PUT,
-                     TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
+from .engine import (CommEngine, TAG_DTD_AUDIT, TAG_INTERNAL_GET,
+                     TAG_INTERNAL_PUT, TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
 
 mca.register("comm_eager_limit", 65536,
              "Payloads up to this many bytes ride inside the activate AM", type=int)
@@ -107,6 +107,8 @@ class RemoteDepEngine:
         ce.tag_register(TAG_INTERNAL_GET, self._on_get)
         ce.tag_register(TAG_INTERNAL_PUT, self._on_put)
         ce.tag_register(TAG_TERMDET, self._on_termdet)
+        ce.tag_register(TAG_DTD_AUDIT, self._on_audit)
+        self._audit_state: Dict[str, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -499,6 +501,73 @@ class RemoteDepEngine:
         n += self.ce.progress()
         n += self._termdet_progress()
         return n
+
+    # ------------------------------------------------------------ audit
+    def _on_audit(self, ce, src, hdr, payload) -> None:
+        # exchanges are keyed by (taskpool, epoch): every rank audits at
+        # the same wait() count, so epochs align and round N+1 reports can
+        # never contaminate round N
+        st = self._audit_state.setdefault(
+            (hdr["tp"], hdr["epoch"]), {"got": {}, "verdict": None})
+        if hdr["kind"] == "report":
+            st["got"][hdr["rank"]] = (hdr["digest"], hdr["count"])
+        else:   # verdict broadcast from rank 0
+            st["verdict"] = hdr["ok"]
+
+    def audit_check(self, tp, digest: int, count: int,
+                    timeout: float = 30.0) -> None:
+        """DTD replay auditor exchange (the DTD analogue of the PTG
+        iterators_checker, ref parsec/mca/pins/iterators_checker/): every
+        rank reports a deterministic digest of its (tile, version, rank)
+        link decisions; rank 0 compares — any divergence between the
+        replayed insert sequences is fatal BEFORE the run can hang or
+        silently corrupt data. An exchange that cannot complete within
+        ``timeout`` is itself fatal on every rank (a silent pass would
+        re-open the silent-hang hole the auditor exists to close)."""
+        import time
+        me = self.ce.my_rank
+        epoch = getattr(tp, "_audit_epoch", 0)
+        tp._audit_epoch = epoch + 1
+        key = (tp.name, epoch)
+        st = self._audit_state.setdefault(key, {"got": {}, "verdict": None})
+        deadline = time.monotonic() + timeout
+        if me == 0:
+            st["got"][0] = (digest, count)
+            while len(st["got"]) < self.ce.nb_ranks \
+                    and time.monotonic() < deadline:
+                self.progress()
+                time.sleep(1e-4)
+            ok = len(st["got"]) == self.ce.nb_ranks and \
+                len(set(st["got"].values())) == 1
+            for r in range(1, self.ce.nb_ranks):
+                self.ce.send_am(TAG_DTD_AUDIT, r,
+                                {"tp": tp.name, "epoch": epoch,
+                                 "kind": "verdict", "ok": ok}, None)
+            got = dict(sorted(st["got"].items()))
+            self._audit_state.pop(key, None)
+            if not ok:
+                output.fatal(
+                    f"DTD replay audit FAILED for {tp.name!r} (epoch "
+                    f"{epoch}): per-rank (digest, count) = {got} — the "
+                    f"ranks did not replay the same insert sequence")
+        else:
+            self.ce.send_am(TAG_DTD_AUDIT, 0,
+                            {"tp": tp.name, "epoch": epoch, "kind": "report",
+                             "rank": me, "digest": digest, "count": count},
+                            None)
+            while st["verdict"] is None and time.monotonic() < deadline:
+                self.progress()
+                time.sleep(1e-4)
+            verdict = st["verdict"]
+            self._audit_state.pop(key, None)
+            if verdict is not True:
+                why = "no verdict arrived (exchange timed out)" \
+                    if verdict is None else "the ranks did not replay the " \
+                    "same insert sequence"
+                output.fatal(
+                    f"DTD replay audit FAILED for {tp.name!r} (epoch "
+                    f"{epoch}, rank {me}: digest={digest:#x} "
+                    f"count={count}) — {why}")
 
     # ------------------------------------------------------------ termdet
     def termdet_local_idle(self, tp) -> None:

@@ -53,6 +53,10 @@ AFFINITY = 0x100          # ref: PARSEC_AFFINITY bit on a dtd param
 
 mca.register("dtd_window_size", 2048,
              "Max in-flight inserted-but-not-executed tasks", type=int)
+mca.register("dtd_audit", False,
+             "Replay auditor: digest every rank's (tile, version, rank) "
+             "link decisions and compare across ranks at wait() (the DTD "
+             "analogue of the PTG iterators_checker)", type=bool)
 mca.register("dtd_threshold_size", 1024,
              "Catch-up target once the window is hit", type=int)
 
@@ -224,6 +228,9 @@ class DTDTaskpool(Taskpool):
         self._open = False
         self._touched_tiles: List[DTDTile] = []
         self._new_tile_count = 0
+        self._audit = mca.get("dtd_audit", False)
+        self._audit_digest = 0      # zlib.crc32 chain: process-independent
+        self._audit_count = 0
         if context.comm is not None:
             # distributed: global termination detection + name-keyed registry
             context.comm.fourcounter.monitor_taskpool(self)
@@ -403,6 +410,17 @@ class DTDTaskpool(Taskpool):
                 tile.wcount += 1
                 tile.last_writer_version = tile.wcount
                 tile.writer_rank = task.rank
+        if self._audit and not tile.new_tile:
+            # deterministic digest of this link decision (crc32: stable
+            # across processes, unlike str hash under PYTHONHASHSEED): all
+            # ranks replay the same COLLECTION-BACKED inserts, so the
+            # chains must agree (tile_new scratch tiles are rank-local by
+            # contract and excluded)
+            import zlib
+            item = repr((tile.key, acc & 0x3, read_version, src_rank,
+                         task.rank)).encode()
+            self._audit_digest = zlib.crc32(item, self._audit_digest)
+            self._audit_count += 1
         if distributed:
             comm = self.ctx.comm
             needs_data = bool(acc & READ)   # pure WRITE flows ship nothing
@@ -619,6 +637,11 @@ class DTDTaskpool(Taskpool):
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """parsec_dtd_taskpool_wait: drain everything this rank executes."""
+        if self._audit and self.ctx.comm is not None and self.ctx.nb_ranks > 1:
+            # replay audit BEFORE blocking on completion: a divergent insert
+            # sequence surfaces as a fatal here instead of a silent hang
+            self.ctx.comm.audit_check(self, self._audit_digest,
+                                      self._audit_count)
         self.ctx.start()
         target = self.local_inserted
         self.ctx._progress_loop(self.ctx.streams[0],

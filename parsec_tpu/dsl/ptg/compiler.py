@@ -466,7 +466,7 @@ class PTGTaskpool(Taskpool):
             env = self._env(task.locals)
             # datatype resolution always compares CANONICAL parameter
             # tuples, independent of any user make_key_fn hash key
-            canonical_key = tuple(task.locals[p] for p in tc._ptg_spec.params)
+            canonical_key = tc._ptg_canonical_key(task)
             for fi, flow in enumerate(tc.flows):
                 alts = tc._ptg_in_specs[fi]
                 ep = tc._ptg_active_in(alts, env)

@@ -204,11 +204,17 @@ def _parse_attr_block(body: str, allowed, what: str, line_no: int,
         raise PTGSyntaxError(
             f"malformed {what} attribute block [{body}] "
             f"(expected 'key = NAME' pairs)", line_no, line)
-    attrs = dict(_RE_DEP_ATTR.findall(body))
-    for k in attrs:
+    pairs = _RE_DEP_ATTR.findall(body)
+    attrs: Dict[str, str] = {}
+    for k, v in pairs:
         if k not in allowed:
             raise PTGSyntaxError(f"unknown {what} attribute {k!r}",
                                  line_no, line)
+        if k in attrs and attrs[k] != v:
+            raise PTGSyntaxError(
+                f"conflicting {what} attribute {k!r}: "
+                f"{attrs[k]!r} vs {v!r}", line_no, line)
+        attrs[k] = v
     return attrs
 
 
