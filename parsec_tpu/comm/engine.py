@@ -32,6 +32,7 @@ TAG_INTERNAL_PUT = 1
 TAG_REMOTE_DEP_ACTIVATE = 2
 TAG_TERMDET = 3
 TAG_DSL_BASE = 4          # TTG-style DSL reservations start here
+TAG_CNT_AGG = 10          # cross-rank counter aggregation at fini
 TAG_DTD_AUDIT = 11        # DTD replay-consistency auditor exchange
 
 # capability flags (ref: parsec_comm_engine capabilities)

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..core import termdet as termdet_mod
 from ..utils import mca, output
-from .engine import (CommEngine, TAG_DTD_AUDIT, TAG_INTERNAL_GET,
+from .engine import (CommEngine, TAG_CNT_AGG, TAG_DTD_AUDIT, TAG_INTERNAL_GET,
                      TAG_INTERNAL_PUT, TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
 
 mca.register("comm_eager_limit", 65536,
@@ -47,6 +47,10 @@ mca.register("comm_coll_bcast", "chain",
              "Multicast tree algorithm (chain|binomial|star)")
 mca.register("comm_thread", False,
              "Dedicated communication progress thread (funnelled model)", type=bool)
+mca.register("counter_aggregate", False,
+             "Gather every rank's counter snapshot at fini and print a "
+             "merged per-rank + sum table on rank 0 (aggregator_visu role)",
+             type=bool)
 
 
 def bcast_children(ranks: Sequence[int], me: int, algo: str) -> List[Tuple[int, List[int]]]:
@@ -109,6 +113,9 @@ class RemoteDepEngine:
         ce.tag_register(TAG_TERMDET, self._on_termdet)
         ce.tag_register(TAG_DTD_AUDIT, self._on_audit)
         self._audit_state: Dict[str, Dict[str, Any]] = {}
+        ce.tag_register(TAG_CNT_AGG, self._on_counter_snap)
+        self._cnt_snaps: Dict[int, Dict[int, Dict[str, Any]]] = {}  # epoch->rank->snap
+        self._cnt_epoch = 0
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -129,9 +136,28 @@ class RemoteDepEngine:
                 time.sleep(50e-6)
 
     def fini(self) -> None:
+        if mca.get("counter_aggregate", False):
+            try:
+                table = self.aggregate_counters()
+                if table is not None:
+                    self._print_counter_table(table)
+            except Exception as e:  # noqa: BLE001 - teardown must proceed
+                output.warning(f"counter aggregation at fini failed: {e}")
         self._enabled = False
         if self._comm_thread is not None:
             self._comm_thread.join(timeout=2.0)
+
+    def _pump_until(self, cond, timeout: float) -> bool:
+        """Progress-pump until ``cond()`` or timeout (the rank-0 gather
+        loop shared by the audit and counter exchanges)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while not cond():
+            if time.monotonic() >= deadline:
+                return False
+            self.progress()
+            time.sleep(1e-4)
+        return True
 
     def register_taskpool(self, tp) -> None:
         # publish under _lock: AM handlers park-or-dispatch under the same
@@ -524,19 +550,15 @@ class RemoteDepEngine:
         silently corrupt data. An exchange that cannot complete within
         ``timeout`` is itself fatal on every rank (a silent pass would
         re-open the silent-hang hole the auditor exists to close)."""
-        import time
         me = self.ce.my_rank
         epoch = getattr(tp, "_audit_epoch", 0)
         tp._audit_epoch = epoch + 1
         key = (tp.name, epoch)
         st = self._audit_state.setdefault(key, {"got": {}, "verdict": None})
-        deadline = time.monotonic() + timeout
         if me == 0:
             st["got"][0] = (digest, count)
-            while len(st["got"]) < self.ce.nb_ranks \
-                    and time.monotonic() < deadline:
-                self.progress()
-                time.sleep(1e-4)
+            self._pump_until(lambda: len(st["got"]) >= self.ce.nb_ranks,
+                             timeout)
             ok = len(st["got"]) == self.ce.nb_ranks and \
                 len(set(st["got"].values())) == 1
             for r in range(1, self.ce.nb_ranks):
@@ -555,9 +577,7 @@ class RemoteDepEngine:
                             {"tp": tp.name, "epoch": epoch, "kind": "report",
                              "rank": me, "digest": digest, "count": count},
                             None)
-            while st["verdict"] is None and time.monotonic() < deadline:
-                self.progress()
-                time.sleep(1e-4)
+            self._pump_until(lambda: st["verdict"] is not None, timeout)
             verdict = st["verdict"]
             self._audit_state.pop(key, None)
             if verdict is not True:
@@ -568,6 +588,66 @@ class RemoteDepEngine:
                     f"DTD replay audit FAILED for {tp.name!r} (epoch "
                     f"{epoch}, rank {me}: digest={digest:#x} "
                     f"count={count}) — {why}")
+
+    # ------------------------------------------------------- counter agg
+    def _on_counter_snap(self, ce, src, hdr, payload) -> None:
+        # epoch-keyed like the audit exchange: a late round-N snapshot can
+        # never satisfy (or contaminate) round N+1
+        self._cnt_snaps.setdefault(hdr["epoch"], {})[hdr["rank"]] = hdr["snap"]
+
+    def aggregate_counters(self, timeout: float = 15.0
+                           ) -> Optional[Dict[str, Any]]:
+        """Cross-rank counter aggregation (ref:
+        tools/aggregator_visu/aggregator.py + papi_sde.c export): every
+        rank ships its counters.py snapshot to rank 0, which merges them
+        into per-rank columns + a SUM row. Returns the merged table on
+        rank 0 (None elsewhere). Enabled at fini via --mca
+        counter_aggregate 1."""
+        from ..utils.counters import counters
+        snap = counters.snapshot()
+        epoch = self._cnt_epoch
+        self._cnt_epoch += 1
+        if self.ce.nb_ranks == 1:
+            return {"per_rank": {0: snap}, "sum": dict(snap)}
+        if self.ce.my_rank != 0:
+            self.ce.send_am(TAG_CNT_AGG, 0,
+                            {"epoch": epoch, "rank": self.ce.my_rank,
+                             "snap": snap}, None)
+            return None
+        got = self._cnt_snaps.setdefault(epoch, {})
+        got[0] = snap
+        self._pump_until(lambda: len(got) >= self.ce.nb_ranks, timeout)
+        missing = [r for r in range(self.ce.nb_ranks) if r not in got]
+        if missing:
+            output.warning(f"counter aggregation: no snapshot from ranks "
+                           f"{missing}")
+        per_rank = dict(sorted(got.items()))
+        total: Dict[str, Any] = {}
+        for s in per_rank.values():
+            for k, v in s.items():
+                if isinstance(v, (int, float)):
+                    total[k] = total.get(k, 0) + v
+        self._cnt_snaps.pop(epoch, None)
+        return {"per_rank": per_rank, "sum": total}
+
+    def _print_counter_table(self, table: Dict[str, Any]) -> None:
+        names = sorted({k for s in table["per_rank"].values() for k in s})
+        if not names:
+            return
+        ranks = list(table["per_rank"])
+        cols = [("counter", [n for n in names])]
+        for r in ranks:
+            cols.append((f"r{r}", [str(table["per_rank"][r].get(n, ""))
+                                   for n in names]))
+        cols.append(("sum", [str(table["sum"].get(n, "")) for n in names]))
+        widths = [max(len(h), max((len(c) for c in body), default=0))
+                  for h, body in cols]
+        def row(cells):
+            return " | ".join(c.ljust(w) for c, w in zip(cells, widths))
+        lines = [row([h for h, _ in cols])]
+        for i in range(len(names)):
+            lines.append(row([body[i] for _, body in cols]))
+        output.inform("cross-rank counters at fini:\n" + "\n".join(lines))
 
     # ------------------------------------------------------------ termdet
     def termdet_local_idle(self, tp) -> None:

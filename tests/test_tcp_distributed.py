@@ -190,3 +190,33 @@ def test_tcp_receives_land_in_arena_buffers():
     stats1, val = results[1]
     assert val == 4.0                       # 2 + (1+1)
     assert stats1["hwm"] >= 1, f"receiver arena never used: {stats1}"
+
+
+def _counter_program(rank, ce):
+    _force_cpu()
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.utils.counters import counters
+
+    ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=ce.nb_ranks)
+    eng = RemoteDepEngine(ctx, ce)
+    counters.register("test.widgets")
+    counters.add("test.widgets", 10 * (rank + 1))   # genuinely per-process
+    ce.sync()
+    table = eng.aggregate_counters(timeout=30)
+    ce.sync()
+    ctx.fini()
+    ce.fini()
+    return table
+
+
+def test_tcp_counter_aggregation():
+    """Cross-rank counter aggregation: rank 0 merges every process's
+    snapshot into per-rank columns + a sum (aggregator_visu role, run on
+    REAL processes so the per-rank values are genuinely distinct)."""
+    results = run_distributed_procs(2, _counter_program, timeout=120)
+    table = results[0]
+    assert results[1] is None          # only rank 0 gets the merged table
+    assert table["per_rank"][0]["test.widgets"] == 10
+    assert table["per_rank"][1]["test.widgets"] == 20
+    assert table["sum"]["test.widgets"] == 30
