@@ -116,6 +116,7 @@ class RemoteDepEngine:
         ce.tag_register(TAG_CNT_AGG, self._on_counter_snap)
         self._cnt_snaps: Dict[int, Dict[int, Dict[str, Any]]] = {}  # epoch->rank->snap
         self._cnt_epoch = 0
+        self._cnt_closed = -1   # highest epoch already merged/abandoned
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -592,7 +593,10 @@ class RemoteDepEngine:
     # ------------------------------------------------------- counter agg
     def _on_counter_snap(self, ce, src, hdr, payload) -> None:
         # epoch-keyed like the audit exchange: a late round-N snapshot can
-        # never satisfy (or contaminate) round N+1
+        # never satisfy (or contaminate) round N+1; stragglers for an
+        # already-merged/abandoned epoch are dropped, not parked forever
+        if hdr["epoch"] <= self._cnt_closed:
+            return
         self._cnt_snaps.setdefault(hdr["epoch"], {})[hdr["rank"]] = hdr["snap"]
 
     def aggregate_counters(self, timeout: float = 15.0
@@ -628,6 +632,7 @@ class RemoteDepEngine:
                 if isinstance(v, (int, float)):
                     total[k] = total.get(k, 0) + v
         self._cnt_snaps.pop(epoch, None)
+        self._cnt_closed = max(self._cnt_closed, epoch)
         return {"per_rank": per_rank, "sum": total}
 
     def _print_counter_table(self, table: Dict[str, Any]) -> None:
