@@ -1,0 +1,50 @@
+"""Sequence/context parallelism over the virtual device mesh: ring
+attention (ppermute K/V rotation + online softmax) and Ulysses all-to-all
+must match dense single-device attention exactly, full and causal."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.parallel.ring_attention import (
+    dense_attention_reference, ring_attention, ulysses_attention, _seq_mesh)
+
+
+def _qkv(B=2, H=8, S=64, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (B, H, S, D)
+    return (rng.standard_normal(shape).astype(np.float32) * 0.5,
+            rng.standard_normal(shape).astype(np.float32) * 0.5,
+            rng.standard_normal(shape).astype(np.float32) * 0.5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    q, k, v = _qkv()
+    mesh = _seq_mesh()
+    assert mesh.devices.size >= 2, "needs the multi-device CPU mesh"
+    out = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    ref = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # the sequence axis really is sharded over the ring
+    assert len(out.sharding.device_set) == mesh.devices.size
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    q, k, v = _qkv()
+    mesh = _seq_mesh()
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    ref = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_long_sequence_blockwise_memory():
+    """A longer sequence still matches: every device only ever holds
+    O(S/P x S/P) score blocks (no global S x S materialization)."""
+    q, k, v = _qkv(B=1, H=2, S=256, D=8, seed=3)
+    out = ring_attention(q, k, v, causal=True)
+    ref = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
