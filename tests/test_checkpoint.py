@@ -1,0 +1,130 @@
+"""Checkpoint / resume (beyond-reference aux subsystem; SURVEY §5 records
+checkpoint/restart as absent in the reference). A run checkpoints its
+collections after quiescence; a FRESH context/collection set restores and
+continues, landing on the same answer as an uninterrupted run."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.remote_dep import RemoteDepEngine
+from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+from parsec_tpu.utils import checkpoint
+
+
+def _mk(name, n=32, ts=8, **kw):
+    dc = TwoDimBlockCyclic(name, n, n, ts, ts, P=kw.pop("P", 1), Q=1, **kw)
+    return dc
+
+
+def _phase(ctx, A, fn, name):
+    tp = DTDTaskpool(ctx, name)
+    for m in range(A.mt):
+        for n in range(A.nt):
+            tp.insert_task(fn, (tp.tile_of(A, m, n), RW), jit=False)
+    tp.wait(timeout=30); tp.close()
+
+
+def test_checkpoint_resume_single(tmp_path):
+    rng = np.random.default_rng(5)
+    init = rng.standard_normal((32, 32)).astype(np.float32)
+    path = str(tmp_path / "ckpt")
+
+    # life 1: phase 1, checkpoint at quiescence
+    ctx = Context(nb_cores=1)
+    A = _mk("CK")
+    A.fill(lambda m, n: init[m*8:(m+1)*8, n*8:(n+1)*8])
+    _phase(ctx, A, lambda x: x * 2.0, "p1")
+    ctx.wait(timeout=30)
+    checkpoint.save(path, {"CK": A})
+    ctx.fini()
+
+    # life 2: FRESH context + collection, restore, phase 2
+    ctx2 = Context(nb_cores=1)
+    A2 = _mk("CK")
+    A2.fill(lambda m, n: np.zeros((8, 8), np.float32))   # junk pre-state
+    n_restored = checkpoint.restore(path, {"CK": A2})
+    assert n_restored == A2.mt * A2.nt
+    _phase(ctx2, A2, lambda x: x + 1.0, "p2")
+    ctx2.wait(timeout=30)
+    ctx2.fini()
+
+    np.testing.assert_allclose(A2.to_dense(), init * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_checkpoint_grid_mismatch_is_fatal(tmp_path):
+    path = str(tmp_path / "ck2")
+    ctx = Context(nb_cores=1)
+    A = _mk("G")
+    A.fill(lambda m, n: np.ones((8, 8), np.float32))
+    checkpoint.save(path, {"G": A})
+    B = TwoDimBlockCyclic("G", 32, 32, 16, 16, P=1, Q=1)   # different tiling
+    B.fill(lambda m, n: np.ones((16, 16), np.float32))
+    with pytest.raises(RuntimeError, match="grid mismatch"):
+        checkpoint.restore(path, {"G": B})
+    ctx.fini()
+
+
+def _dist_life1(rank, fabric, init, path):
+    ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+    RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+    A = _mk("DCK", P=2, nodes=2, myrank=rank)
+    A.fill(lambda m, n: init[m*8:(m+1)*8, n*8:(n+1)*8])
+    tp = DTDTaskpool(ctx, "p1")
+    # cross-rank dataflow before the checkpoint: every tile reads its
+    # vertical neighbor's (0, col) anchor on rank 0
+    anchors = [tp.tile_of(A, 0, n) for n in range(A.nt)]
+    for m in range(1, A.mt):
+        for n in range(A.nt):
+            tp.insert_task(lambda x, a: x + a[0, 0], (tp.tile_of(A, m, n), RW),
+                           (anchors[n], READ), jit=False)
+    tp.data_flush_all(A)
+    tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30)
+    out = checkpoint.save(path, {"DCK": A}, rank=rank)
+    ctx.fini()
+    return out
+
+
+def _dist_life2(rank, fabric, path):
+    ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+    RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+    A = _mk("DCK", P=2, nodes=2, myrank=rank)
+    A.fill(lambda m, n: np.zeros((8, 8), np.float32))
+    checkpoint.restore(path, {"DCK": A}, rank=rank)
+    tp = DTDTaskpool(ctx, "p2")
+    for m in range(A.mt):
+        for n in range(A.nt):
+            tp.insert_task(lambda x: x * 10.0, (tp.tile_of(A, m, n), RW),
+                           jit=False)
+    tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30)
+    mine = {(m, n): np.asarray(A.data_of(m, n).newest_copy().payload)
+            for m in range(A.mt) for n in range(A.nt)
+            if A.rank_of(m, n) == rank}
+    ctx.fini()
+    return mine
+
+
+def test_checkpoint_resume_distributed(tmp_path):
+    """2-rank run checkpoints per-rank shards at quiescence; a brand-new
+    2-rank run restores and continues."""
+    rng = np.random.default_rng(9)
+    init = rng.standard_normal((32, 32)).astype(np.float32)
+    path = str(tmp_path / "dck")
+
+    run_distributed(2, lambda r, f: _dist_life1(r, f, init, path), timeout=60)
+    results = run_distributed(2, lambda r, f: _dist_life2(r, f, path),
+                              timeout=60)
+    full = {}
+    for mine in results:
+        full.update(mine)
+
+    expect = init.copy()
+    for m in range(1, 4):
+        for n in range(4):
+            expect[m*8:(m+1)*8, n*8:(n+1)*8] += init[0, n*8]
+    expect *= 10.0
+    for (m, n), tile in full.items():
+        np.testing.assert_allclose(tile, expect[m*8:(m+1)*8, n*8:(n+1)*8],
+                                   rtol=1e-5)
