@@ -527,6 +527,21 @@ class RemoteDepEngine:
                     break
         n += self.ce.progress()
         n += self._termdet_progress()
+        if n == 0:
+            # failure detection (SURVEY §5 names it; the reference has
+            # none): only after a FRUITLESS drain — frames the dead peer
+            # sent before dying were queued ahead of the EOF and may still
+            # terminate the taskpool cleanly — a dead peer with live
+            # taskpools is an attributed fatal, not a hang until timeout
+            dead = getattr(self.ce, "dead_peers", None)
+            if dead:
+                live = [name for name, st in self._td_state.items()
+                        if not st["terminated"]]
+                if live:
+                    output.fatal(
+                        f"rank(s) {sorted(dead)} FAILED (connection lost "
+                        f"without clean shutdown) while taskpool(s) {live} "
+                        f"are still running on rank {self.ce.my_rank}")
         return n
 
     # ------------------------------------------------------------ audit

@@ -50,6 +50,8 @@ _LEN = struct.Struct("!I")
 _KIND_AM = 0
 _KIND_BAR = 1        # barrier arrival (sent to rank 0)
 _KIND_BAR_REL = 2    # barrier release (rank 0 -> all)
+_KIND_BYE = 3        # clean shutdown notice (fini) — EOF after this is
+                     # a normal departure, EOF without it is a FAILURE
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, obj,
@@ -140,6 +142,10 @@ class TCPCE(CommEngine):
         self._inbound: "collections.deque" = collections.deque()
         self._readers: List[threading.Thread] = []
         self._closing = False
+        #: ranks whose connection died while the job was still live
+        #: (failure detection: surfaced by the protocol layer's progress)
+        self.dead_peers: set = set()
+        self._departed: set = set()   # ranks that said BYE (clean exits)
         self.sent_msgs = 0
         self.recv_msgs = 0
         # barrier state
@@ -241,8 +247,19 @@ class TCPCE(CommEngine):
                                f"died on {type(e).__name__}: {e}")
                 frame = None
             if frame is None:
+                if not self._closing and rank not in self._departed:
+                    # the peer died mid-job: a clean shutdown says BYE
+                    # first — record it (and wake any barrier waiter) so
+                    # the failure is attributed instead of hanging to a
+                    # timeout
+                    with self._bar_cv:
+                        self.dead_peers.add(rank)
+                        self._bar_cv.notify_all()
                 return
             kind = frame[0]
+            if kind == _KIND_BYE:
+                self._departed.add(rank)
+                return
             if kind == _KIND_AM:
                 self._inbound.append(frame[1:])
             elif kind == _KIND_BAR:
@@ -298,11 +315,19 @@ class TCPCE(CommEngine):
         with self._bar_cv:
             self._bar_epoch += 1
             epoch = self._bar_epoch
+        def _dead_check():
+            if self.dead_peers:
+                raise RuntimeError(
+                    f"rank(s) {sorted(self.dead_peers)} FAILED while rank "
+                    f"{self.my_rank} was in a barrier (epoch {epoch})")
         if self.my_rank == 0:
             with self._bar_cv:
                 ok = self._bar_cv.wait_for(
-                    lambda: self._bar_arrivals.get(epoch, 0) >= self.nb_ranks - 1,
+                    lambda: self.dead_peers or
+                    self._bar_arrivals.get(epoch, 0) >= self.nb_ranks - 1,
                     timeout=timeout)
+                if self._bar_arrivals.get(epoch, 0) < self.nb_ranks - 1:
+                    _dead_check()
                 if not ok:
                     raise TimeoutError(f"barrier epoch {epoch} timed out")
                 del self._bar_arrivals[epoch]
@@ -313,15 +338,22 @@ class TCPCE(CommEngine):
             _send_frame(self._peers[0], self._peer_locks[0],
                         (_KIND_BAR, epoch))
             with self._bar_cv:
-                ok = self._bar_cv.wait_for(lambda: epoch in self._bar_released,
-                                           timeout=timeout)
+                ok = self._bar_cv.wait_for(
+                    lambda: self.dead_peers or epoch in self._bar_released,
+                    timeout=timeout)
+                if epoch not in self._bar_released:
+                    _dead_check()
                 if not ok:
                     raise TimeoutError(f"barrier epoch {epoch} timed out")
                 self._bar_released.discard(epoch)
 
     def fini(self) -> None:
         self._closing = True
-        for sock in self._peers.values():
+        for rank, sock in self._peers.items():
+            try:   # best-effort goodbye so peers see a departure, not a death
+                _send_frame(sock, self._peer_locks[rank], (_KIND_BYE,))
+            except OSError:
+                pass
             try:
                 sock.shutdown(socket.SHUT_RDWR)
             except OSError:

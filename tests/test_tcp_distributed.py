@@ -220,3 +220,47 @@ def test_tcp_counter_aggregation():
     assert table["per_rank"][0]["test.widgets"] == 10
     assert table["per_rank"][1]["test.widgets"] == 20
     assert table["sum"]["test.widgets"] == 30
+
+
+def _victim_or_survivor(rank, ce):
+    _force_cpu()
+    import socket as _socket
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+
+    ctx = _mkctx(rank, ce)
+    A = TwoDimBlockCyclic("FD", 32, 16, 16, 16, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    A.fill(lambda m, n: np.ones((16, 16), np.float32))
+    tp = DTDTaskpool(ctx, "faildet")
+    src = tp.tile_of(A, 0, 0)   # rank 0 (the victim) produces
+    dst = tp.tile_of(A, 1, 0)   # rank 1 (the survivor) consumes
+    tp.insert_task(lambda x: x + 1.0, (src, RW), jit=False, name="w")
+    tp.insert_task(lambda y, x: y + x, (dst, RW), (src, READ),
+                   jit=False, name="r")
+    if rank == 0:
+        # simulate a crash: sever every connection WITHOUT the BYE
+        # handshake (the process itself stays alive to report to the
+        # parent, so the survivor's observation can be asserted directly)
+        for s in ce._peers.values():
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+        return "victim-done"
+    try:
+        tp.wait(timeout=60)
+        return "no-error"
+    except RuntimeError as e:
+        return "attributed" if "FAILED" in str(e) and "0" in str(e) \
+            else f"other: {e}"
+
+
+def test_tcp_rank_failure_is_attributed():
+    """A peer dying mid-job (no clean shutdown) surfaces as a prompt,
+    attributed fatal on the survivor instead of a silent hang (failure
+    detection — SURVEY §5 lists it; the reference has none)."""
+    results = run_distributed_procs(2, _victim_or_survivor, timeout=120)
+    assert results[0] == "victim-done"
+    assert results[1] == "attributed", results[1]
