@@ -1,0 +1,125 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+
+Each device owns ONE stage's parameters (stage-major pytrees sharded over
+``pp``); microbatches enter stage 0, activations hop one neighbor per tick
+via ``lax.ppermute`` (the ICI ring), and after the P-1 fill ticks every
+device computes every tick — the classic (M + P - 1)-tick GPipe schedule
+expressed as one ``lax.scan`` inside ``shard_map``. The task runtime
+expresses the same pattern as cross-rank chain deps (examples/ex03); this
+is the compiler-scheduled, jittable form.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+
+def make_pp_mesh(n_devices: Optional[int] = None):
+    from .spmd import make_1d_mesh
+    return make_1d_mesh("pp", n_devices)
+
+
+def init_pipeline_params(seed: int, n_stages: int, d: int,
+                         dtype=np.float32):
+    """Stage-major weights: one (W, b) per stage, leading axis = stage."""
+    rng = np.random.default_rng(seed)
+    s = np.sqrt(1.0 / d)
+    return {
+        "w": (rng.standard_normal((n_stages, d, d)) * s).astype(dtype),
+        "b": np.zeros((n_stages, d), dtype),
+    }
+
+
+def stage_apply(w, b, x):
+    """One pipeline stage: x -> gelu(x W + b) + x."""
+    import jax
+    return x + jax.nn.gelu(x @ w + b)
+
+
+def reference_forward(params, x):
+    """Sequential application of all stages (the single-device truth)."""
+    import jax.numpy as jnp
+    out = jnp.asarray(x)
+    for i in range(params["w"].shape[0]):
+        out = stage_apply(jnp.asarray(params["w"][i]),
+                          jnp.asarray(params["b"][i]), out)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _pipe_call(mesh, n_micro: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    nP = mesh.devices.size
+    perm = [(i, (i + 1) % nP) for i in range(nP)]
+
+    def local(w, b, xs):
+        # w: (1, d, d) this device's stage; xs: (n_micro, B, d) microbatches
+        # (replicated input; stage 0 consumes them in order)
+        idx = jax.lax.axis_index(axis)
+        w0, b0 = w[0], b[0]
+        # zero initials derived from the (device-varying) stage weights so
+        # the scan carry is varying from step 0 (shard_map's manual-axes
+        # type system requires carry-in == carry-out)
+        zv = w0[0, 0] * 0.0
+        act = jnp.zeros(xs.shape[1:], xs.dtype) + zv   # the in-flight bubble
+        out = jnp.zeros_like(xs) + zv       # filled on the LAST stage
+
+        def tick(carry, t):
+            act, out = carry
+            # stage 0 ingests microbatch t (while t < n_micro)
+            feed = jnp.where(t < n_micro, 1.0, 0.0)
+            mb = xs[jnp.minimum(t, n_micro - 1)]
+            act = jnp.where(idx == 0, feed * mb, act)
+            act = stage_apply(w0, b0, act)
+            # the LAST stage retires microbatch t-(P-1)
+            done = t - (nP - 1)
+            is_out = jnp.logical_and(idx == nP - 1, done >= 0)
+            slot = jnp.maximum(done, 0)
+            out = jnp.where(is_out, out.at[slot].set(act), out)
+            act = jax.lax.ppermute(act, axis, perm)
+            return (act, out), None
+
+        (act, out), _ = jax.lax.scan(tick, (act, out),
+                                     jnp.arange(n_micro + nP - 1))
+        # outputs live on the last stage only: everyone else holds zeros,
+        # one psum replicates them (tiny shapes; fine for validation/driver)
+        return jax.lax.psum(jnp.where(idx == nP - 1, out, 0.0), axis)
+
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None), P()),
+        out_specs=P()))
+
+
+def pipeline_forward(params, x, mesh=None, n_micro: Optional[int] = None):
+    """Run (n_micro, B, d) microbatches through the P-stage pipeline.
+
+    ``params['w']``: (P, d, d) — stage i's weights live on device i.
+    Returns (n_micro, B, d), bit-equal to :func:`reference_forward` applied
+    per microbatch.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh if mesh is not None else make_pp_mesh()
+    axis = mesh.axis_names[0]
+    nP = mesh.devices.size
+    assert params["w"].shape[0] == nP, \
+        f"{params['w'].shape[0]} stages need a {params['w'].shape[0]}-device" \
+        f" mesh (have {nP})"
+    xs = np.asarray(x)
+    m = n_micro if n_micro is not None else xs.shape[0]
+    xs = xs[:m]        # honor the (n_micro, B, d) return contract exactly
+    fn = _pipe_call(mesh, m)
+    wd = jax.device_put(params["w"], NamedSharding(mesh, P(axis, None, None)))
+    bd = jax.device_put(params["b"], NamedSharding(mesh, P(axis, None)))
+    xd = jax.device_put(xs, NamedSharding(mesh, P()))
+    return fn(wd, bd, xd)

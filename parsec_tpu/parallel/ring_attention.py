@@ -33,14 +33,8 @@ import numpy as np
 
 def _seq_mesh(n_devices: Optional[int] = None):
     """A 1D mesh over the sequence-parallel axis ``sp``."""
-    import jax
-    from jax.sharding import Mesh
-    devs = jax.devices()
-    n = n_devices or len(devs)
-    if n > len(devs):
-        raise ValueError(f"requested {n} sequence-parallel devices, "
-                         f"have {len(devs)}")
-    return Mesh(np.array(devs[:n]), ("sp",))
+    from .spmd import make_1d_mesh
+    return make_1d_mesh("sp", n_devices)
 
 
 def _fold_block(acc, k, v, src, q, scale, causal, q_pos, k_pos0, block):

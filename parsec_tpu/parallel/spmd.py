@@ -45,6 +45,18 @@ def best_grid(n: int) -> Tuple[int, int]:
     return p, n // p
 
 
+def make_1d_mesh(axis_name: str, n_devices: Optional[int] = None):
+    """A 1D mesh over the first n devices (the seq/pipeline/expert axis
+    builder shared by ring_attention/pipeline/moe)."""
+    jax = _jax()
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices for axis {axis_name!r}, "
+                         f"have {len(devs)}")
+    return jax.sharding.Mesh(np.array(devs[:n]), (axis_name,))
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axis_names: Tuple[str, str] = ("p", "q")):
     """Build a 2D device mesh over the available chips.
