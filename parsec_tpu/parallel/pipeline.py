@@ -103,8 +103,8 @@ def pipeline_forward(params, x, mesh=None, n_micro: Optional[int] = None):
     """Run (n_micro, B, d) microbatches through the P-stage pipeline.
 
     ``params['w']``: (P, d, d) — stage i's weights live on device i.
-    Returns (n_micro, B, d), bit-equal to :func:`reference_forward` applied
-    per microbatch.
+    Returns (n_micro, B, d), matching :func:`reference_forward` applied per
+    microbatch within float32 tolerance.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -117,6 +117,8 @@ def pipeline_forward(params, x, mesh=None, n_micro: Optional[int] = None):
         f" mesh (have {nP})"
     xs = np.asarray(x)
     m = n_micro if n_micro is not None else xs.shape[0]
+    assert m <= xs.shape[0], \
+        f"n_micro={m} exceeds the {xs.shape[0]} provided microbatches"
     xs = xs[:m]        # honor the (n_micro, B, d) return contract exactly
     fn = _pipe_call(mesh, m)
     wd = jax.device_put(params["w"], NamedSharding(mesh, P(axis, None, None)))
