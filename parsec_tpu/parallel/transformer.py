@@ -54,27 +54,33 @@ def _ln(x, g, b, eps=1e-5):
     return (x - mu) / jnp.sqrt(var + eps) * g + b
 
 
-def block_apply(params, x, causal: bool = True):
+def _dense_attention_core(q, k, v, causal: bool, scale: float):
+    import jax
+    import jax.numpy as jnp
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    a = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", a, v)
+
+
+def block_apply(params, x, causal: bool = True, attention=None):
     """One pre-LN transformer block: x -> x + MHA(LN(x)) -> + MLP(LN(.)).
 
     ``x``: (batch, seq, d_model). Pure jax math — the sharding story is
     entirely in the jit annotations of :func:`make_train_step`.
-    """
+    ``attention(q, k, v, causal, scale)`` swaps the attention core (the
+    sequence-parallel variant passes the ring)."""
     import jax
     import jax.numpy as jnp
-    B, S, D = x.shape
-    H = params["wqkv"].shape[1]
     dh = params["wqkv"].shape[3]
+    attn = attention if attention is not None else _dense_attention_core
 
     h = _ln(x, params["ln1_g"], params["ln1_b"])
     qkv = jnp.einsum("bsd,chdk->cbhsk", h, params["wqkv"])   # (3,B,H,S,dh)
-    q, k, v = qkv[0], qkv[1], qkv[2]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(dh)
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    a = jax.nn.softmax(s, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+    ctx = attn(qkv[0], qkv[1], qkv[2], causal, 1.0 / float(np.sqrt(dh)))
     x = x + jnp.einsum("bhsd,hdo->bso", ctx, params["wo"])
 
     h = _ln(x, params["ln2_g"], params["ln2_b"])
@@ -138,6 +144,64 @@ def make_train_step(mesh, dp: str = "dp", tp: str = "tp",
 
     def place_params(params):
         return {k: jax.device_put(v, pspec[k]) for k, v in params.items()}
+
+    def place_batch(x):
+        return jax.device_put(x, xsh)
+
+    return fn, place_params, place_batch
+
+
+def block_apply_sp(params, x, mesh, causal: bool = True):
+    """The same pre-LN block with the SEQUENCE axis sharded over ``mesh``:
+    attention runs as ring attention (ppermute K/V rotation, online
+    softmax — :mod:`parsec_tpu.parallel.ring_attention`), the LN/MLP parts
+    are token-local so GSPMD keeps them sharded for free. Fully
+    differentiable: the ring's transpose is the reverse ring."""
+    from .ring_attention import ring_attention
+
+    def ring_core(q, k, v, causal, scale):
+        return ring_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
+
+    return block_apply(params, x, causal=causal, attention=ring_core)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_sp_step(mesh, lr: float, causal: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert len(mesh.axis_names) == 1, \
+        f"sequence-parallel training needs a 1D mesh (got axes " \
+        f"{mesh.axis_names}); use make_1d_mesh/_seq_mesh"
+    axis = mesh.axis_names[0]
+    psp = NamedSharding(mesh, P())       # params replicated (pytree prefix)
+    xsh = NamedSharding(mesh, P(None, axis, None))   # seq sharded
+
+    def step(params, x, y):
+        def loss_fn(p):
+            out = block_apply_sp(p, x, mesh, causal=causal)
+            return jnp.mean((out - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        return new_params, loss
+
+    return jax.jit(step, in_shardings=(psp, xsh, xsh),
+                   out_shardings=(psp, NamedSharding(mesh, P()))), \
+        psp, xsh
+
+
+def make_sp_train_step(mesh, lr: float = 1e-2, causal: bool = True):
+    """Long-context training: the sequence axis sharded over the mesh,
+    attention via the ring — per-chip memory O(S/P · S/P), no S×S
+    anywhere, gradients riding the reverse ring. Same return shape as
+    :func:`make_train_step`."""
+    import jax
+    fn, psp, xsh = _compiled_sp_step(mesh, float(lr), causal)
+
+    def place_params(params):
+        return {k: jax.device_put(v, psp) for k, v in params.items()}
 
     def place_batch(x):
         return jax.device_put(x, xsh)
