@@ -111,3 +111,40 @@ def test_geqrf_builder(ctx):
         for k in range(m):
             tile = np.asarray(A.data_of(m, k).newest_copy().payload)
             assert np.abs(tile).max() < 1e-3
+
+
+def test_dtd_gemm_bf16_tiles(ctx):
+    """bf16 tile GEMM with per-step f32 dots (the MXU-native mixed
+    precision the real-chip bench flips to): the DTD DAG over bf16
+    payloads matches the f32 product within bf16 tolerance."""
+    import jax.numpy as jnp
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    N, TS = 128, 32
+    rng = np.random.default_rng(21)
+    a = rng.standard_normal((N, N)).astype(np.float32)
+    b = rng.standard_normal((N, N)).astype(np.float32)
+
+    def mk(name, src):
+        M = TwoDimBlockCyclic(name, N, N, TS, TS, P=1, Q=1,
+                              dtype=jnp.bfloat16)
+        M.fill(lambda m, n: jnp.asarray(src[m*TS:(m+1)*TS, n*TS:(n+1)*TS],
+                                        dtype=jnp.bfloat16))
+        return M
+
+    A, B = mk("BFA", a), mk("BFB", b)
+    C = TwoDimBlockCyclic("BFC", N, N, TS, TS, P=1, Q=1, dtype=jnp.bfloat16)
+    C.fill(lambda m, n: jnp.zeros((TS, TS), jnp.bfloat16))
+    tp = DTDTaskpool(ctx, "bf16gemm")
+    insert_gemm_tasks(tp, A, B, C, batch_k=True)
+    assert tp.wait(timeout=60)
+    tp.close()
+    assert ctx.wait(timeout=60) == 0
+    got = np.zeros((N, N), np.float32)
+    for m in range(N // TS):
+        for n in range(N // TS):
+            got[m*TS:(m+1)*TS, n*TS:(n+1)*TS] = np.asarray(
+                C.data_of(m, n).newest_copy().payload, dtype=np.float32)
+    ref = (a.astype(np.float32) @ b.astype(np.float32))
+    # bf16 storage of inputs/outputs: ~3 decimal digits
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.5 * np.sqrt(N))
