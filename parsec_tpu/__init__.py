@@ -39,4 +39,45 @@ __all__ = [
     "HOOK_ERROR",
     "FLOW_ACCESS_READ", "FLOW_ACCESS_WRITE", "FLOW_ACCESS_RW",
     "FLOW_ACCESS_CTL", "DEV_CPU", "DEV_TPU", "DEV_ALL",
+    # lazy (PEP 562) exports below
+    "DTDTaskpool", "READ", "WRITE", "RW", "AFFINITY", "compile_ptg",
+    "TiledMatrix", "TwoDimBlockCyclic", "NamedDatatype",
+    "RemoteDepEngine", "ThreadsCE", "TCPCE", "run_distributed",
+    "run_distributed_procs", "init_from_env", "checkpoint",
 ]
+
+# the rest of the user surface resolves lazily so `import parsec_tpu`
+# stays light (DSLs, collections, comm backends pull in their own deps)
+_LAZY = {
+    "DTDTaskpool": ("parsec_tpu.dsl.dtd", "DTDTaskpool"),
+    "READ": ("parsec_tpu.dsl.dtd", "READ"),
+    "WRITE": ("parsec_tpu.dsl.dtd", "WRITE"),
+    "RW": ("parsec_tpu.dsl.dtd", "RW"),
+    "AFFINITY": ("parsec_tpu.dsl.dtd", "AFFINITY"),
+    "compile_ptg": ("parsec_tpu.dsl.ptg.compiler", "compile_ptg"),
+    "TiledMatrix": ("parsec_tpu.data.matrix", "TiledMatrix"),
+    "TwoDimBlockCyclic": ("parsec_tpu.data.matrix", "TwoDimBlockCyclic"),
+    "NamedDatatype": ("parsec_tpu.data.reshape", "NamedDatatype"),
+    "RemoteDepEngine": ("parsec_tpu.comm.remote_dep", "RemoteDepEngine"),
+    "ThreadsCE": ("parsec_tpu.comm.threads", "ThreadsCE"),
+    "TCPCE": ("parsec_tpu.comm.tcp", "TCPCE"),
+    "run_distributed": ("parsec_tpu.comm.threads", "run_distributed"),
+    "run_distributed_procs": ("parsec_tpu.comm.tcp", "run_distributed_procs"),
+    "init_from_env": ("parsec_tpu.comm.tcp", "init_from_env"),
+    "checkpoint": ("parsec_tpu.utils.checkpoint", None),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(entry[0])
+    value = mod if entry[1] is None else getattr(mod, entry[1])
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

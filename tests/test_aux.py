@@ -306,3 +306,18 @@ def test_device_profiling_stream():
         assert st["streams"] >= 1 and st["events"] >= 6  # 3 begin + 3 end
     finally:
         M.params.unset("device_tpu_over_cpu")
+
+
+def test_top_level_exports_resolve():
+    """The user surface a switcher reaches for is importable from the
+    package root (lazily, so `import parsec_tpu` stays light)."""
+    import parsec_tpu as pt
+    assert pt.DTDTaskpool.__name__ == "DTDTaskpool"
+    assert callable(pt.compile_ptg)
+    assert pt.TwoDimBlockCyclic and pt.TiledMatrix and pt.NamedDatatype
+    assert pt.RemoteDepEngine and pt.ThreadsCE and pt.TCPCE
+    assert callable(pt.run_distributed) and callable(pt.run_distributed_procs)
+    assert callable(pt.checkpoint.save) and callable(pt.checkpoint.restore)
+    assert pt.READ | pt.RW | pt.AFFINITY
+    with pytest.raises(AttributeError):
+        pt.no_such_symbol
