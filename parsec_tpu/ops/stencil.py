@@ -209,3 +209,93 @@ def reference_stencil2d(dense: np.ndarray, iterations: int,
         xr = np.concatenate([x[:, 1:], z[:, :1]], axis=1)
         x = wc * x + wu * xu + wd * xd + wl * xl + wr * xr
     return x
+
+
+# ---------------------------------------------------------------------------
+# 3D stencil (7-point) — BASELINE config 4's 3D variant: slab decomposition
+# in Z (halo exchange across tiles), XY handled in-brick (one fused VPU
+# pass per slab — the TPU-friendly split: the decomposed dimension carries
+# the dataflow, the dense dimensions stay inside the XLA kernel)
+# ---------------------------------------------------------------------------
+
+def stencil3d_body(x, above, below,
+                   w=(0.4, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1)):
+    """One Jacobi step of the 7-point stencil on a (sz, ny, nx) brick with
+    Z halo planes from the neighbor slabs (zeros at the domain boundary)."""
+    import jax.numpy as jnp
+    wc, wzm, wzp, wym, wyp, wxm, wxp = w
+    aplane = above[-1:, :, :] if above is not None else jnp.zeros_like(x[:1])
+    bplane = below[:1, :, :] if below is not None else jnp.zeros_like(x[:1])
+    zm = jnp.concatenate([aplane, x[:-1]], axis=0)
+    zp = jnp.concatenate([x[1:], bplane], axis=0)
+    ym = jnp.concatenate([jnp.zeros_like(x[:, :1]), x[:, :-1]], axis=1)
+    yp = jnp.concatenate([x[:, 1:], jnp.zeros_like(x[:, :1])], axis=1)
+    xm = jnp.concatenate([jnp.zeros_like(x[..., :1]), x[..., :-1]], axis=2)
+    xp = jnp.concatenate([x[..., 1:], jnp.zeros_like(x[..., :1])], axis=2)
+    return wc * x + wzm * zm + wzp * zp + wym * ym + wyp * yp \
+        + wxm * xm + wxp * xp
+
+
+_BODIES3D = {}
+
+
+def _body3d_for(has, w):
+    key = (has, w)
+    b = _BODIES3D.get(key)
+    if b is not None:
+        return b
+    ha, hb = has
+
+    def body(x, *halos):
+        above = halos[0] if ha else None
+        below = halos[ha] if hb else None
+        return stencil3d_body(x, above, below, w)
+
+    wrapped = _StencilTask(body)
+    _BODIES3D[key] = wrapped
+    return wrapped
+
+
+def insert_stencil3d_tasks(tp: DTDTaskpool, bricks_a, bricks_b,
+                           iterations: int,
+                           weights=(0.4, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1)) -> int:
+    """Jacobi 7-point stencil over Z-slab bricks (lists of DTD tiles, each
+    holding a (sz, ny, nx) payload), A <-> B double buffering; the Z halo
+    reads become remote deps when slabs live on different ranks."""
+    assert len(bricks_a) == len(bricks_b)
+    nz = len(bricks_a)
+    n0 = tp.inserted
+    src, dst = list(bricks_a), list(bricks_b)
+    for _ in range(iterations):
+        for zi in range(nz):
+            has = (zi > 0, zi < nz - 1)
+            args = [(dst[zi], RW | AFFINITY), (src[zi], READ)]
+            if has[0]:
+                args.append((src[zi - 1], READ))
+            if has[1]:
+                args.append((src[zi + 1], READ))
+            tp.insert_task(_body3d_for(has, tuple(weights)), *args,
+                           name="ST3D")
+        src, dst = dst, src
+    return tp.inserted - n0
+
+
+def reference_stencil3d(dense: np.ndarray, iterations: int,
+                        w=(0.4, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1)) -> np.ndarray:
+    wc, wzm, wzp, wym, wyp, wxm, wxp = w
+    x = dense.astype(np.float32)
+
+    def shift(a, axis, direction):
+        pad = np.zeros_like(np.take(a, [0], axis=axis))
+        if direction > 0:       # neighbor at index-1 (shift content down)
+            body = np.take(a, range(a.shape[axis] - 1), axis=axis)
+            return np.concatenate([pad, body], axis=axis)
+        body = np.take(a, range(1, a.shape[axis]), axis=axis)
+        return np.concatenate([body, pad], axis=axis)
+
+    for _ in range(iterations):
+        x = (wc * x
+             + wzm * shift(x, 0, +1) + wzp * shift(x, 0, -1)
+             + wym * shift(x, 1, +1) + wyp * shift(x, 1, -1)
+             + wxm * shift(x, 2, +1) + wxp * shift(x, 2, -1))
+    return x
