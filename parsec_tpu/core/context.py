@@ -46,6 +46,11 @@ from .task import (
 
 mca.register("runtime_nb_cores", 0, "Worker threads (0 = autodetect)", type=int)
 mca.register("runtime_backoff_max_us", 1000, "Max starvation backoff (µs)", type=int)
+mca.register("debug_paranoid", 0,
+             "Assertion tier (ref: PARSEC_DEBUG_PARANOID): >0 adds runtime "
+             "invariant checks in the scheduling hot path (not-ready or "
+             "completed tasks entering the queues, double completion)",
+             type=int)
 
 
 class ExecutionStream:
@@ -88,6 +93,7 @@ class Context:
         self.my_rank = my_rank
         self.nb_ranks = nb_ranks
         self.pins = pins_mod.PinsManager()
+        self.paranoid = mca.get("debug_paranoid", 0)
         from .vpmap import VPMap
         self.vpmap = VPMap(nb_threads=self.nb_cores)
         self.streams: List[ExecutionStream] = [
@@ -215,6 +221,21 @@ class Context:
         tasks = list(tasks)
         if not tasks:
             return
+        if self.paranoid:
+            # PARANOID tier 1+ (ref: PARSEC_DEBUG_PARANOID build flavor):
+            # a task entering the ready queues must actually be ready, and
+            # must not already be completed/queued
+            for t in tasks:
+                # DTD tasks carry an explicit deps_remaining counter; PTG
+                # readiness lives in the repo goal tables (base Task has no
+                # such field)
+                unmet = getattr(t, "deps_remaining", 0)
+                if unmet > 0:
+                    output.fatal(f"PARANOID: {t!r} scheduled with "
+                                 f"{unmet} unmet dependencies")
+                if t.status == TASK_STATUS_COMPLETE:
+                    output.fatal(f"PARANOID: completed task {t!r} "
+                                 f"re-scheduled")
         stream = stream or self._current_stream()
         self.pins.fire(pins_mod.SCHEDULE_BEGIN, stream, tasks)
         self.sched.schedule(stream, tasks, distance)
@@ -356,6 +377,8 @@ class Context:
     def complete_task_execution(self, stream: ExecutionStream, task: Task) -> None:
         """__parsec_complete_execution (ref: scheduling.c:469)."""
         tc = task.task_class
+        if self.paranoid and task.status == TASK_STATUS_COMPLETE:
+            output.fatal(f"PARANOID: {task!r} completed twice")
         task.status = TASK_STATUS_COMPLETE
         self.pins.fire(pins_mod.COMPLETE_EXEC_BEGIN, stream, task)
         if tc.prepare_output is not None:

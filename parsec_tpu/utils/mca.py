@@ -179,4 +179,5 @@ params = ParamRegistry()
 register = params.register
 get = params.get
 set = params.set
+unset = params.unset
 parse_cmdline = params.parse_cmdline

@@ -344,3 +344,73 @@ def test_scheduler_policy_separation(sched, chain_early):
         assert frac < 0.5, f"{sched}: chain finished after {frac:.0%} of fillers"
     else:
         assert frac > 0.5, f"{sched}: chain finished after only {frac:.0%}"
+
+
+def test_paranoid_tier_catches_premature_schedule():
+    """--mca debug_paranoid 1: scheduling a task with unmet deps (or
+    re-scheduling a completed one) is an immediate attributed fatal — the
+    PARSEC_DEBUG_PARANOID assertion tier."""
+    from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+    from parsec_tpu.utils import mca
+
+    mca.set("debug_paranoid", 1)
+    ctx = None
+    try:
+        ctx = Context(nb_cores=1)
+        tp = DTDTaskpool(ctx, "paranoid")
+        t = tp.tile_new((2, 2))
+        task = tp.insert_task(lambda x: x + 1.0, (t, RW), jit=False)
+        tp.wait(); tp.close(); ctx.wait()
+        # seeded bug 1: re-schedule the completed task
+        with pytest.raises(RuntimeError, match="PARANOID.*re-scheduled"):
+            ctx.schedule([task])
+        # seeded bug 2: a task with unmet deps enters the queues
+        task.status = 0
+        task.deps_remaining = 3
+        with pytest.raises(RuntimeError, match="PARANOID.*unmet"):
+            ctx.schedule([task])
+    finally:
+        if ctx is not None:
+            ctx.fini()
+        mca.unset("debug_paranoid")
+
+
+def test_paranoid_ptg_clean_run():
+    """PTG taskpools (base Task, no deps_remaining field) run clean under
+    the paranoid tier (regression: the check crashed on the missing
+    attribute instead of passing valid DAGs)."""
+    import numpy as np
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    from parsec_tpu.data.matrix import TiledMatrix
+    from parsec_tpu.utils import mca
+
+    src = """
+%global descA
+T(k)
+  k = 0 .. 3
+  : descA(0, k)
+  RW X <- descA(0, k)
+     -> descA(0, k)
+BODY
+  X = X + 1.0
+END
+"""
+    mca.set("debug_paranoid", 1)
+    ctx = None
+    try:
+        ctx = Context(nb_cores=1)
+        A = TiledMatrix("PARG", 4, 16, 4, 4)
+        A.fill(lambda m, n: np.zeros((4, 4), np.float32))
+        tp = compile_ptg(src, "par").instantiate(ctx, collections={"descA": A})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+        np.testing.assert_allclose(A.to_dense(), 1.0)
+    finally:
+        if ctx is not None:
+            ctx.fini()
+        mca.unset("debug_paranoid")
+
+
+def test_paranoid_off_by_default(context):
+    """The hot path carries no paranoid cost unless asked for."""
+    assert context.paranoid == 0
