@@ -97,9 +97,20 @@ class CommEngine:
             on_complete()
 
     def get(self, src: int, remote_handle, on_complete=None) -> None:
+        """Request the remote buffer; data arrives as the matching PUT.
+
+        Unlike :meth:`put`, ``on_complete`` CANNOT fire here — the GET is
+        only a request, and completion is observable solely through the
+        PUT delivery on the registered tag.  Passing a callback is a
+        caller bug (it would wait forever), so it is rejected loudly.
+        """
+        if on_complete is not None:
+            raise ValueError(
+                "CommEngine.get() cannot invoke on_complete: completion "
+                "arrives as the matching PUT on the registered tag — hook "
+                "the PUT delivery instead")
         self.send_am(TAG_INTERNAL_GET, src,
                      {"handle": remote_handle, "requester": self.my_rank}, None)
-        # completion arrives as the matching PUT from the target
 
     # --- progress / sync ----------------------------------------------------
     def progress(self) -> int:

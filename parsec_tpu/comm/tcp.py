@@ -152,8 +152,13 @@ class TCPCE(CommEngine):
         self._bar_lock = threading.Lock()
         self._bar_cv = threading.Condition(self._bar_lock)
         self._bar_epoch = 0
-        self._bar_arrivals: Dict[int, int] = collections.defaultdict(int)
-        self._bar_released: set = set()
+        # epoch -> set of ranks whose arrival frame was seen (a set, not a
+        # count: a cleanly-departed rank that already arrived must not be
+        # mistaken for one blocking the barrier)
+        self._bar_arrivals: Dict[int, set] = {}
+        # epoch -> (dead_ranks, exited_ranks) rank 0 observed
+        # (([], []) = clean release)
+        self._bar_released: Dict[int, Tuple[List[int], List[int]]] = {}
         if nb_ranks > 1:
             self._bootstrap(rendezvous, timeout)
             for rank, sock in self._peers.items():
@@ -182,13 +187,23 @@ class TCPCE(CommEngine):
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return conn
 
+        def _recv_expect(conn: socket.socket, kind: str):
+            """Receive one handshake frame, attributing EOF and wrong-kind
+            frames (checked before unpack — arity varies by kind)."""
+            frame = _recv_frame(conn)
+            if frame is None:
+                raise RuntimeError(f"bootstrap: peer EOF before {kind}")
+            if frame[0] != kind:
+                raise RuntimeError(
+                    f"bootstrap: expected {kind}, got {frame[0]!r}")
+            return frame[1:]
+
         if self.my_rank == 0:
             # collect hellos, then broadcast the address map
             addrs: Dict[int, Tuple[str, int]] = {0: my_addr}
             for _ in range(self.nb_ranks - 1):
                 conn = _accept()
-                kind, rank, addr = _recv_frame(conn)
-                assert kind == "hello"
+                rank, addr = _recv_expect(conn, "hello")
                 addrs[rank] = tuple(addr)
                 self._peers[rank] = conn
             for rank, conn in self._peers.items():
@@ -199,8 +214,7 @@ class TCPCE(CommEngine):
             conn0 = self._dial(tuple(rendezvous), deadline)
             lock0 = self._peer_locks.setdefault(0, threading.Lock())
             _send_frame(conn0, lock0, ("hello", self.my_rank, my_addr))
-            kind, addrs = _recv_frame(conn0)
-            assert kind == "map"
+            (addrs,) = _recv_expect(conn0, "map")
             self._peers[0] = conn0
             # dial every lower non-zero rank, accept from every higher one
             for rank in range(1, self.my_rank):
@@ -210,8 +224,7 @@ class TCPCE(CommEngine):
                 self._peers[rank] = conn
             for _ in range(self.my_rank + 1, self.nb_ranks):
                 conn = _accept()
-                kind, rank = _recv_frame(conn)
-                assert kind == "peer"
+                (rank,) = _recv_expect(conn, "peer")
                 self._peers[rank] = conn
                 self._peer_locks.setdefault(rank, threading.Lock())
         listener.close()
@@ -258,17 +271,24 @@ class TCPCE(CommEngine):
                 return
             kind = frame[0]
             if kind == _KIND_BYE:
-                self._departed.add(rank)
+                # wake barrier waiters: a clean exit while peers still sit
+                # in a barrier is a collective divergence they must see
+                # attributed, not hang to a timeout
+                with self._bar_cv:
+                    self._departed.add(rank)
+                    self._bar_cv.notify_all()
                 return
             if kind == _KIND_AM:
                 self._inbound.append(frame[1:])
             elif kind == _KIND_BAR:
                 with self._bar_cv:
-                    self._bar_arrivals[frame[1]] += 1
+                    self._bar_arrivals.setdefault(frame[1], set()).add(rank)
                     self._bar_cv.notify_all()
             elif kind == _KIND_BAR_REL:
                 with self._bar_cv:
-                    self._bar_released.add(frame[1])
+                    # (epoch, dead_ranks, cleanly_exited_ranks)
+                    self._bar_released[frame[1]] = \
+                        (frame[2], frame[3]) if len(frame) > 3 else ([], [])
                     self._bar_cv.notify_all()
 
     # ------------------------------------------------------------ AM path
@@ -321,31 +341,76 @@ class TCPCE(CommEngine):
                     f"rank(s) {sorted(self.dead_peers)} FAILED while rank "
                     f"{self.my_rank} was in a barrier (epoch {epoch})")
         if self.my_rank == 0:
+            def _blocking_exits():
+                # cleanly-departed ranks that never arrived can block the
+                # barrier forever: a collective divergence, attributed
+                arrived = self._bar_arrivals.get(epoch, set())
+                return sorted(self._departed - arrived)
             with self._bar_cv:
                 ok = self._bar_cv.wait_for(
-                    lambda: self.dead_peers or
-                    self._bar_arrivals.get(epoch, 0) >= self.nb_ranks - 1,
+                    lambda: self.dead_peers or _blocking_exits() or
+                    len(self._bar_arrivals.get(epoch, ()))
+                    >= self.nb_ranks - 1,
                     timeout=timeout)
-                if self._bar_arrivals.get(epoch, 0) < self.nb_ranks - 1:
-                    _dead_check()
-                if not ok:
-                    raise TimeoutError(f"barrier epoch {epoch} timed out")
-                del self._bar_arrivals[epoch]
-            for rank in self._peers:
-                _send_frame(self._peers[rank], self._peer_locks[rank],
-                            (_KIND_BAR_REL, epoch))
+                dead = sorted(self.dead_peers)
+                gone = _blocking_exits()
+                self._bar_arrivals.pop(epoch, None)
+            if ok or dead or gone:
+                # fan out the release even on failure (carrying the failed
+                # list): an asymmetric link break only rank 0 observed must
+                # not strand healthy peers into a misleading barrier
+                # timeout — they raise attributed instead
+                for rank in self._peers:
+                    try:
+                        _send_frame(self._peers[rank],
+                                    self._peer_locks[rank],
+                                    (_KIND_BAR_REL, epoch, dead, gone))
+                    except OSError:
+                        # a dead socket must not abort releases to the
+                        # healthy ranks; readers attribute the death
+                        pass
+            # a dead peer is a job failure even if its arrival was counted
+            # before it died
+            _dead_check()
+            if gone:
+                raise RuntimeError(
+                    f"rank(s) {gone} exited cleanly while rank 0 was in a "
+                    f"barrier (epoch {epoch}): collective divergence")
+            if not ok:
+                raise TimeoutError(f"barrier epoch {epoch} timed out")
         else:
-            _send_frame(self._peers[0], self._peer_locks[0],
-                        (_KIND_BAR, epoch))
+            try:
+                _send_frame(self._peers[0], self._peer_locks[0],
+                            (_KIND_BAR, epoch))
+            except OSError:
+                # rank 0 already gone (e.g. it raised on another rank's
+                # death and exited): fall through to the wait, where the
+                # already-delivered release/dead-list attributes the
+                # failure instead of a raw BrokenPipeError
+                pass
             with self._bar_cv:
                 ok = self._bar_cv.wait_for(
-                    lambda: self.dead_peers or epoch in self._bar_released,
+                    lambda: self.dead_peers or 0 in self._departed or
+                    epoch in self._bar_released,
                     timeout=timeout)
-                if epoch not in self._bar_released:
-                    _dead_check()
-                if not ok:
-                    raise TimeoutError(f"barrier epoch {epoch} timed out")
-                self._bar_released.discard(epoch)
+                rel = self._bar_released.pop(epoch, None)
+                root_gone = rel is None and 0 in self._departed
+                _dead_check()   # our own observation of a death wins
+            if rel is not None and rel[0]:
+                raise RuntimeError(
+                    f"rank(s) {rel[0]} FAILED while rank {self.my_rank} "
+                    f"was in a barrier (epoch {epoch}, reported by rank 0)")
+            if rel is not None and rel[1]:
+                raise RuntimeError(
+                    f"rank(s) {rel[1]} exited cleanly while rank "
+                    f"{self.my_rank} was in a barrier (epoch {epoch}): "
+                    f"collective divergence (reported by rank 0)")
+            if root_gone:
+                raise RuntimeError(
+                    f"rank 0 exited cleanly while rank {self.my_rank} was "
+                    f"in a barrier (epoch {epoch}): collective divergence")
+            if not ok:
+                raise TimeoutError(f"barrier epoch {epoch} timed out")
 
     def fini(self) -> None:
         self._closing = True

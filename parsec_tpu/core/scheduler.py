@@ -112,8 +112,10 @@ class _LockedHeap:
         self._ctr = itertools.count()
 
     def push(self, task: Task, sign: int = -1, tie_lifo: bool = False) -> None:
-        ctr = next(self._ctr)
         with self.lock:
+            # counter drawn under the lock: acquisition order == insertion
+            # order, so the FIFO/LIFO tiebreak among equal priorities holds
+            ctr = next(self._ctr)
             heapq.heappush(self.heap,
                            (sign * task.priority,
                             -ctr if tie_lifo else ctr, task))

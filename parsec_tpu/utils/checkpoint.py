@@ -49,6 +49,7 @@ def save(path: str, collections: Dict[str, Any],
     """
     arrays: Dict[str, np.ndarray] = {}
     versions: Dict[str, int] = {}
+    skipped: list = []
     manifest: Dict[str, Any] = {"collections": {}}
     for name, dc in collections.items():
         manifest["collections"][name] = {
@@ -58,9 +59,13 @@ def save(path: str, collections: Dict[str, Any],
         for m, n in _owned_tiles(dc, rank):
             data = dc.data_of(m, n)
             copy = data.newest_copy()
-            if copy is None or copy.payload is None:
-                continue
             key = f"{name}/{m}_{n}"
+            if copy is None or copy.payload is None:
+                # never-materialized tile (e.g. lazily-allocated, never
+                # touched): recorded so strict restore can tell an
+                # intentional absence from a torn checkpoint
+                skipped.append(key)
+                continue
             arrays[key] = np.asarray(copy.payload)
             versions[key] = int(copy.version)
     suffix = f".r{rank}" if rank is not None else ""
@@ -68,7 +73,8 @@ def save(path: str, collections: Dict[str, Any],
     os.makedirs(os.path.dirname(npz_path) or ".", exist_ok=True)
     tmp = npz_path + ".tmp"
     with open(tmp, "wb") as f:          # atomic publish: no torn checkpoints
-        np.savez(f, __versions__=json.dumps(versions), **arrays)
+        np.savez(f, __versions__=json.dumps(versions),
+                 __skipped__=json.dumps(skipped), **arrays)
     os.replace(tmp, npz_path)
     man_path = f"{path}.manifest.json"
     if rank in (None, 0):
@@ -111,12 +117,16 @@ def restore(path: str, collections: Dict[str, Any],
     npz_path = f"{path}{suffix}.npz"
     with np.load(npz_path, allow_pickle=False) as z:
         versions = json.loads(str(z["__versions__"]))
+        skipped = set(json.loads(str(z["__skipped__"]))) \
+            if "__skipped__" in z else set()
         restored = 0
         for name, dc in collections.items():
             for m, n in _owned_tiles(dc, rank):
                 key = f"{name}/{m}_{n}"
                 if key not in z:
-                    if strict:
+                    # strict restore fatals only on tiles the checkpoint
+                    # claims should exist; save() records intentional skips
+                    if strict and key not in skipped:
                         output.fatal(f"checkpoint missing tile {key}")
                     continue
                 arr = z[key]

@@ -264,3 +264,31 @@ def test_tcp_rank_failure_is_attributed():
     results = run_distributed_procs(2, _victim_or_survivor, timeout=120)
     assert results[0] == "victim-done"
     assert results[1] == "attributed", results[1]
+
+
+def _divergent_sync(rank, ce):
+    """Rank 1 skips the barrier and exits cleanly; the others must see an
+    attributed collective-divergence error, not a bare barrier timeout."""
+    if rank == 1:
+        import time
+        time.sleep(0.3)       # let the others enter the barrier first
+        ce.fini()             # clean BYE without ever calling sync()
+        return "skipped"
+    try:
+        ce.sync(timeout=20)
+        return "no-error"
+    except RuntimeError as e:
+        return "attributed" if "divergence" in str(e) and "1" in str(e) \
+            else f"other: {e}"
+    except TimeoutError:
+        return "timeout"
+
+
+def test_tcp_clean_exit_mid_barrier_is_attributed():
+    """A peer departing cleanly (BYE) while others wait in a barrier is a
+    collective divergence surfaced as an attributed error on every waiter
+    (rank 0 observes it directly; non-roots via the failed-list release)."""
+    results = run_distributed_procs(3, _divergent_sync, timeout=60)
+    assert results[1] == "skipped"
+    assert results[0] == "attributed", results[0]
+    assert results[2] == "attributed", results[2]
