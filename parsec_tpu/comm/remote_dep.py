@@ -82,6 +82,7 @@ class RemoteDepEngine:
         self.ctx = ctx
         self.ce = ce
         ctx.comm = self
+        ctx._need_wake = True   # comm progress waits on the work event
         ctx.my_rank = ce.my_rank
         ctx.nb_ranks = ce.nb_ranks
         self._cmds: "collections.deque" = collections.deque()  # the dequeue
@@ -214,6 +215,8 @@ class RemoteDepEngine:
                     task.deps_remaining += 1
                 self._expected.setdefault(key, []).append((tp, task, flow_index))
                 return
+        if task.pending_inputs is None:
+            task.pending_inputs = {}
         task.pending_inputs[flow_index] = payload
 
     def note_send(self, tp, tile, version: int, dst_rank: int,
@@ -234,6 +237,8 @@ class RemoteDepEngine:
             # same lock, so an attach can never be lost in between
             with writer.lock:
                 if not writer.completed:
+                    if writer.remote_sends is None:
+                        writer.remote_sends = {}
                     writer.remote_sends.setdefault(id(tile),
                                                    (tile, version, set()))
                     writer.remote_sends[id(tile)][2].add(dst_rank)
@@ -252,7 +257,7 @@ class RemoteDepEngine:
         task's OWN output for the tile (a later local writer may already
         have advanced the tile's newest copy)."""
         sends = getattr(task, "remote_sends", None)
-        if sends is None:
+        if not sends:
             return
         with task.lock:   # excludes concurrent note_send attaches
             entries = list(sends.values())
@@ -453,26 +458,41 @@ class RemoteDepEngine:
             # remote_dep_mpi.c:2120): a tile that was device-resident stays
             # device-resident — refresh its accelerator copy in place so the
             # consumer's stage-in sees a version-valid device copy instead
-            # of forcing a host->device transfer
-            for dev_index, dcopy in list(tile.data.copies.items()):
-                if dev_index == 0 or dcopy is None:
-                    continue
-                dev = next((d for d in self.ctx.devices.devices
-                            if getattr(d, "device_index", None) == dev_index),
-                           None)
+            # of forcing a host->device transfer. With the ICI backend the
+            # payload ALREADY lives in this rank's device HBM: it becomes
+            # the device copy as-is (zero-copy landing), created if absent.
+            pdevs = None
+            try:
+                import jax
+                if isinstance(payload, jax.Array):
+                    pdevs = payload.devices()
+            except Exception:   # noqa: BLE001 - jax optional at this layer
+                pass
+            for dev in self.ctx.devices.devices:
                 jd = getattr(dev, "jax_device", None)
                 if jd is None:
                     continue
+                dev_index = dev.device_index
+                dcopy = tile.data.get_copy(dev_index)
+                already_here = pdevs is not None and pdevs == {jd}
+                if dcopy is None and not already_here:
+                    continue   # no resident copy to refresh, payload remote
                 try:
-                    import jax
-                    dcopy.payload = jax.device_put(payload, jd)
+                    if dcopy is None:
+                        dcopy = tile.data.create_copy(
+                            dev_index, payload, COHERENCY_SHARED)
+                    else:
+                        dcopy.payload = payload if already_here \
+                            else jax.device_put(payload, jd)
+                        dcopy.coherency_state = COHERENCY_SHARED
                     dcopy.version = host.version
-                    dcopy.coherency_state = COHERENCY_SHARED
-                except Exception as e:  # noqa: BLE001 - fall back to host copy
+                except Exception as e:  # noqa: BLE001 - host copy suffices
                     output.debug_verbose(1, "comm",
                                          f"device landing failed: {e}")
         ready = []
         for wtp, task, flow_index in waiters:
+            if task.pending_inputs is None:
+                task.pending_inputs = {}
             task.pending_inputs[flow_index] = payload
             if task.dep_satisfied():
                 ready.append(task)

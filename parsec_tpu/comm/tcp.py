@@ -301,7 +301,13 @@ class TCPCE(CommEngine):
         if payload is not None and hasattr(payload, "shape") \
                 and hasattr(payload, "dtype"):
             # device arrays materialize host bytes HERE, at the wire
-            # boundary — the protocol layer above never forces them
+            # boundary — the protocol layer above never forces them.
+            # Counted so the ICI backend's "zero host materializations"
+            # property is assertable against this stream transport
+            # (comm/ici.py docstring).
+            if type(payload).__module__.split(".")[0] not in ("numpy",):
+                from ..utils.counters import counters
+                counters.add("comm.host_materialized_msgs")
             a = np.ascontiguousarray(np.asarray(payload))
             if a.dtype.kind in "fiub":   # exotic dtypes (bf16) ride pickle
                 meta = (tuple(a.shape), a.dtype.str)
