@@ -240,53 +240,4 @@ void pt_zone_stats(void *zv, int64_t *out4) {
     out4[3] = largest * z->unit;
 }
 
-// ---------------------------------------------------------------------------
-// work deque of opaque uint64 handles (ref: parsec/class/lifo.c + dequeue)
-// ---------------------------------------------------------------------------
-
-struct pt_deque {
-    std::deque<uint64_t> q;
-    std::mutex lock;
-};
-
-void *pt_deque_create() { return new (std::nothrow) pt_deque(); }
-void pt_deque_destroy(void *d) { delete static_cast<pt_deque *>(d); }
-
-void pt_deque_push_front(void *dv, uint64_t h) {
-    auto *d = static_cast<pt_deque *>(dv);
-    std::lock_guard<std::mutex> g(d->lock);
-    d->q.push_front(h);
-}
-
-void pt_deque_push_back(void *dv, uint64_t h) {
-    auto *d = static_cast<pt_deque *>(dv);
-    std::lock_guard<std::mutex> g(d->lock);
-    d->q.push_back(h);
-}
-
-// returns 0 when empty (valid handles must be nonzero)
-uint64_t pt_deque_pop_front(void *dv) {
-    auto *d = static_cast<pt_deque *>(dv);
-    std::lock_guard<std::mutex> g(d->lock);
-    if (d->q.empty()) return 0;
-    uint64_t h = d->q.front();
-    d->q.pop_front();
-    return h;
-}
-
-uint64_t pt_deque_pop_back(void *dv) {
-    auto *d = static_cast<pt_deque *>(dv);
-    std::lock_guard<std::mutex> g(d->lock);
-    if (d->q.empty()) return 0;
-    uint64_t h = d->q.back();
-    d->q.pop_back();
-    return h;
-}
-
-int64_t pt_deque_size(void *dv) {
-    auto *d = static_cast<pt_deque *>(dv);
-    std::lock_guard<std::mutex> g(d->lock);
-    return (int64_t)d->q.size();
-}
-
 }  // extern "C"

@@ -46,11 +46,52 @@ from .task import (
 
 mca.register("runtime_nb_cores", 0, "Worker threads (0 = autodetect)", type=int)
 mca.register("runtime_backoff_max_us", 1000, "Max starvation backoff (µs)", type=int)
+mca.register("runtime_gc_defer", True,
+             "Stretch Python cyclic-GC thresholds while taskpools are in "
+             "flight (the mempool discipline of the reference: no "
+             "allocator churn in the hot path). Task/tile graphs are "
+             "cyclic and mostly LIVE mid-DAG, so frequent young-gen scans "
+             "only promote them and full collections walk the whole heap "
+             "— measured ~2x EP task throughput. Fully disabling GC "
+             "instead would leak jax buffer cycles and force a costly "
+             "whole-heap collect at quiescence (measured 3x on tiled "
+             "POTRF), so thresholds are stretched, not switched off",
+             type=bool)
 mca.register("debug_paranoid", 0,
              "Assertion tier (ref: PARSEC_DEBUG_PARANOID): >0 adds runtime "
              "invariant checks in the scheduling hot path (not-ready or "
              "completed tasks entering the queues, double completion)",
              type=int)
+
+
+# process-wide refcount for the GC-stretch window (several rank contexts
+# can live in one process; gc thresholds are global)
+_gc_defer_lock = threading.Lock()
+_gc_defer_count = 0
+_gc_saved_thresholds = None
+_GC_STRETCHED = (50_000, 20, 20)    # vs the (700, 10, 10) default
+
+
+def _gc_defer_acquire() -> None:
+    global _gc_defer_count, _gc_saved_thresholds
+    import gc
+    with _gc_defer_lock:
+        _gc_defer_count += 1
+        if _gc_defer_count == 1:
+            _gc_saved_thresholds = gc.get_threshold()
+            gc.set_threshold(*_GC_STRETCHED)
+
+
+def _gc_defer_release() -> None:
+    global _gc_defer_count, _gc_saved_thresholds
+    import gc
+    with _gc_defer_lock:
+        if _gc_defer_count == 0:
+            return
+        _gc_defer_count -= 1
+        if _gc_defer_count == 0 and _gc_saved_thresholds is not None:
+            gc.set_threshold(*_gc_saved_thresholds)
+            _gc_saved_thresholds = None
 
 
 class ExecutionStream:
@@ -117,6 +158,15 @@ class Context:
         self._workers: List[threading.Thread] = []
         self._work_event = threading.Event()
         self._error: Optional[BaseException] = None
+        # per-thread stream binding (was a thread-NAME parse on every
+        # schedule() — the single hottest line of the EP profile)
+        self._tls = threading.local()
+        self._tls.stream = self.streams[0]
+        # schedule() only needs to wake anyone when parked workers or a
+        # comm thread exist; single-core local runs skip the Event syscall
+        # (RemoteDepEngine flips this when it attaches)
+        self._need_wake = self.nb_cores > 1
+        self._gc_held = False
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
 
@@ -131,6 +181,10 @@ class Context:
         with self._cv:
             self._taskpools[tp.taskpool_id] = tp
             self._active += 1
+            first = self._active == 1
+        if first and not self._gc_held and mca.get("runtime_gc_defer", True):
+            self._gc_held = True
+            _gc_defer_acquire()
         # taskpool keeps one pending action for the enqueue itself
         tp.addto_nb_pending_actions(1)
         if tp.on_enqueue is not None:
@@ -148,7 +202,11 @@ class Context:
             if tp.taskpool_id in self._taskpools:
                 del self._taskpools[tp.taskpool_id]
                 self._active -= 1
+            quiesced = self._active == 0
             self._cv.notify_all()
+        if quiesced and self._gc_held:
+            self._gc_held = False
+            _gc_defer_release()
 
     # ------------------------------------------------------------------ start/wait
     def start(self) -> None:
@@ -211,6 +269,9 @@ class Context:
         self.devices.fini()
         if self.comm is not None:
             self.comm.fini()
+        if self._gc_held:   # error paths can finalize with pools active
+            self._gc_held = False
+            _gc_defer_release()
 
     # ------------------------------------------------------------------ scheduling
     def schedule(self, tasks, stream: Optional[ExecutionStream] = None,
@@ -237,19 +298,23 @@ class Context:
                     output.fatal(f"PARANOID: completed task {t!r} "
                                  f"re-scheduled")
         stream = stream or self._current_stream()
-        self.pins.fire(pins_mod.SCHEDULE_BEGIN, stream, tasks)
-        self.sched.schedule(stream, tasks, distance)
-        self.pins.fire(pins_mod.SCHEDULE_END, stream, tasks)
-        self._work_event.set()
+        if self.pins.enabled:
+            self.pins.fire(pins_mod.SCHEDULE_BEGIN, stream, tasks)
+            self.sched.schedule(stream, tasks, distance)
+            self.pins.fire(pins_mod.SCHEDULE_END, stream, tasks)
+        else:
+            self.sched.schedule(stream, tasks, distance)
+        if self._need_wake:
+            self._work_event.set()
 
     def _current_stream(self) -> ExecutionStream:
-        name = threading.current_thread().name
-        if name.startswith("parsec-tpu-worker-"):
-            return self.streams[int(name.rsplit("-", 1)[1])]
-        return self.streams[0]
+        # threadlocal binding (workers bind in _worker_main); unknown
+        # threads (user code, comm thread) act as the master stream
+        return getattr(self._tls, "stream", None) or self.streams[0]
 
     # ------------------------------------------------------------------ hot loop
     def _worker_main(self, stream: ExecutionStream) -> None:
+        self._tls.stream = stream
         if mca.get("runtime_bind_threads", False):
             from .vpmap import bind_current_thread
             bind_current_thread(self.vpmap.core_of(stream.th_id))
@@ -279,14 +344,35 @@ class Context:
             stream.next_task = None
             distance = 0
             if task is None:
-                self.pins.fire(pins_mod.SELECT_BEGIN, stream, None)
-                task, distance = self.sched.select(stream)
-                self.pins.fire(pins_mod.SELECT_END, stream, task)
+                if self.pins.enabled:
+                    self.pins.fire(pins_mod.SELECT_BEGIN, stream, None)
+                    task, distance = self.sched.select(stream)
+                    self.pins.fire(pins_mod.SELECT_END, stream, task)
+                else:
+                    task, distance = self.sched.select(stream)
                 stream.nb_selects += 1
             if task is not None:
                 misses = 0
                 try:
-                    self._task_progress(stream, task, distance)
+                    # drain a small burst before re-checking the loop
+                    # conditions: the per-iteration overhead (until, error,
+                    # comm, device polls) is pure cost for fine-grain tasks.
+                    # Burst selects skip the SELECT pins events, so the
+                    # burst collapses to 1 while instrumentation is on
+                    budget = 1 if self.pins.enabled else 32
+                    while True:
+                        self._task_progress(stream, task, distance)
+                        budget -= 1
+                        if budget <= 0:
+                            break
+                        task = stream.next_task
+                        stream.next_task = None
+                        distance = 0
+                        if task is None:
+                            task, distance = self.sched.select(stream)
+                            stream.nb_selects += 1
+                            if task is None:
+                                break
                 except BaseException as e:  # noqa: BLE001
                     # a failing body must surface to every waiter, not die
                     # silently with one worker thread (ref: hook errors are
@@ -312,12 +398,20 @@ class Context:
         tc = task.task_class
         if task.status < TASK_STATUS_PREPARE_INPUT:
             task.status = TASK_STATUS_PREPARE_INPUT
-            self.pins.fire(pins_mod.PREPARE_INPUT_BEGIN, stream, task)
+            pins_on = self.pins.enabled
+            if tc.prepare_input is None and not tc.flows and not pins_on:
+                # nothing to resolve — but only skip the PREPARE pins
+                # events when instrumentation is off (trace consumers pair
+                # intervals and must see symmetric streams per task)
+                return self._execute(stream, task)
+            if pins_on:
+                self.pins.fire(pins_mod.PREPARE_INPUT_BEGIN, stream, task)
             if tc.prepare_input is not None:
                 rc = tc.prepare_input(stream, task)
             else:
                 rc = self.generic_prepare_input(stream, task)
-            self.pins.fire(pins_mod.PREPARE_INPUT_END, stream, task)
+            if pins_on:
+                self.pins.fire(pins_mod.PREPARE_INPUT_END, stream, task)
             if rc == HOOK_AGAIN:
                 self.schedule([task], stream, distance)
                 return rc
@@ -342,12 +436,15 @@ class Context:
                     task.chore_mask &= ~chore.device_type
                     continue
             task.selected_chore = chore
-            self.pins.fire(pins_mod.EXEC_BEGIN, stream, task)
+            pins_on = self.pins.enabled
+            if pins_on:
+                self.pins.fire(pins_mod.EXEC_BEGIN, stream, task)
             rc = chore.hook(stream, task)
             stream.nb_executed += 1
             # return-code dispatch (ref: scheduling.c:518-566)
             if rc == HOOK_DONE:
-                self.pins.fire(pins_mod.EXEC_END, stream, task)
+                if pins_on:
+                    self.pins.fire(pins_mod.EXEC_END, stream, task)
                 if device is not None:
                     device.executed_tasks += 1  # async devices count in epilog
                 self.complete_task_execution(stream, task)
@@ -357,10 +454,12 @@ class Context:
                 # device; the EXEC interval closes here (it measures host
                 # dispatch — device execution shows on the device's own
                 # profiling stream)
-                self.pins.fire(pins_mod.EXEC_END, stream, task)
+                if pins_on:
+                    self.pins.fire(pins_mod.EXEC_END, stream, task)
                 return rc
             if rc == HOOK_AGAIN:
-                self.pins.fire(pins_mod.EXEC_END, stream, task)
+                if pins_on:
+                    self.pins.fire(pins_mod.EXEC_END, stream, task)
                 self.schedule([task], stream, distance=1)  # __parsec_reschedule :445
                 return rc
             if rc == HOOK_NEXT:
@@ -380,18 +479,22 @@ class Context:
         if self.paranoid and task.status == TASK_STATUS_COMPLETE:
             output.fatal(f"PARANOID: {task!r} completed twice")
         task.status = TASK_STATUS_COMPLETE
-        self.pins.fire(pins_mod.COMPLETE_EXEC_BEGIN, stream, task)
+        pins_on = self.pins.enabled
+        if pins_on:
+            self.pins.fire(pins_mod.COMPLETE_EXEC_BEGIN, stream, task)
         if tc.prepare_output is not None:
             tc.prepare_output(stream, task)
         if tc.complete_execution is not None:
             tc.complete_execution(stream, task)
-        self.pins.fire(pins_mod.RELEASE_DEPS_BEGIN, stream, task)
+        if pins_on:
+            self.pins.fire(pins_mod.RELEASE_DEPS_BEGIN, stream, task)
         if tc.release_deps is not None:
             tc.release_deps(stream, task)
         else:
             self.generic_release_deps(stream, task)
-        self.pins.fire(pins_mod.RELEASE_DEPS_END, stream, task)
-        self.pins.fire(pins_mod.COMPLETE_EXEC_END, stream, task)
+        if pins_on:
+            self.pins.fire(pins_mod.RELEASE_DEPS_END, stream, task)
+            self.pins.fire(pins_mod.COMPLETE_EXEC_END, stream, task)
         if task.on_complete is not None:
             task.on_complete(task)
         task.taskpool.addto_nb_tasks(-1)

@@ -75,27 +75,34 @@ class SchedulerModule:
 # ---------------------------------------------------------------------------
 
 class _LockedDeque:
-    __slots__ = ("dq", "lock")
+    """Thread-safe dequeue with NO explicit lock: every operation is a
+    single collections.deque call, which CPython guarantees atomic under
+    the GIL (append/extend/popleft/pop). Emptiness is handled by catching
+    IndexError instead of check-then-act — the name is kept for its role
+    (the reference's parsec_dequeue, which does lock)."""
+
+    __slots__ = ("dq",)
 
     def __init__(self) -> None:
         self.dq: deque = deque()
-        self.lock = threading.Lock()
 
     def push_front(self, items) -> None:
-        with self.lock:
-            self.dq.extendleft(reversed(items))
+        self.dq.extendleft(reversed(items))
 
     def push_back(self, items) -> None:
-        with self.lock:
-            self.dq.extend(items)
+        self.dq.extend(items)
 
     def pop_front(self):
-        with self.lock:
-            return self.dq.popleft() if self.dq else None
+        try:
+            return self.dq.popleft()
+        except IndexError:
+            return None
 
     def pop_back(self):
-        with self.lock:
-            return self.dq.pop() if self.dq else None
+        try:
+            return self.dq.pop()
+        except IndexError:
+            return None
 
     def __len__(self) -> int:
         return len(self.dq)
@@ -584,10 +591,13 @@ class SchedRND(_GlobalBase):
     def install(self, context) -> None:
         super().install(context)
         self._rng = random.Random(0xC0FFEE)
+        # random-position inserts are compound ops; _LockedDeque itself is
+        # lock-free (single GIL-atomic calls), so this module keeps its own
+        self._rnd_lock = threading.Lock()
 
     def schedule(self, stream, tasks, distance: int = 0) -> None:
         tasks = list(tasks)
-        with self._q.lock:
+        with self._rnd_lock:
             for t in tasks:
                 if self._q.dq and self._rng.random() < 0.5:
                     self._q.dq.insert(self._rng.randrange(len(self._q.dq) + 1), t)

@@ -99,7 +99,7 @@ class Flow:
     deps_out: List[Dep] = field(default_factory=list)   # who consumes it
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskData:
     """Per-flow data slot of a task (ref: parsec_data_pair_t)."""
     data_in: Any = None          # DataCopy consumed
@@ -149,6 +149,9 @@ class TaskClass:
         self.release_deps: Optional[Callable[..., int]] = None
         self.data_affinity: Optional[Callable[["Task"], Any]] = None
         self.time_estimate: Optional[Callable[["Task", Any], float]] = None
+        # (registry weakref, epoch, {mask: device tuple}) — owned by
+        # DeviceRegistry.select_best_device; lives/dies with this class
+        self._dev_sel_cache = None
 
     def add_flow(self, flow: Flow) -> Flow:
         flow.flow_index = len(self.flows)
@@ -162,6 +165,11 @@ class TaskClass:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"<TaskClass {self.name}#{self.task_class_id}>"
+
+
+#: shared locals for task instances that carry none (DTD tasks identify by
+#: insertion index, not named parameters) — never mutate this dict
+_EMPTY_LOCALS: Dict[str, int] = {}
 
 
 class Task:
@@ -182,7 +190,8 @@ class Task:
     ) -> None:
         self.taskpool = taskpool
         self.task_class = task_class
-        self.locals: Dict[str, int] = locals_ or {}
+        self.locals: Dict[str, int] = \
+            locals_ if locals_ is not None else _EMPTY_LOCALS
         self.priority = priority
         self.chore_mask = DEV_ALL
         self.status = TASK_STATUS_NONE

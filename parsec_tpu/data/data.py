@@ -114,6 +114,15 @@ class Data:
     def newest_copy(self) -> Optional[DataCopy]:
         """The copy with the highest version (candidate transfer source,
         ref: stage_in source selection device_gpu.c:1800)."""
+        copies = self.copies
+        if len(copies) == 1:
+            # hot path: single-copy data (the common host-only case) — the
+            # read is one GIL-atomic dict access, no lock needed
+            try:
+                c = next(iter(copies.values()))
+                return None if c.coherency_state == COHERENCY_INVALID else c
+            except (StopIteration, RuntimeError):
+                pass    # raced a concurrent attach/detach: take the lock
         with self._lock:
             best = None
             for c in self.copies.values():

@@ -104,6 +104,8 @@ class DeviceRegistry:
     def __init__(self, context) -> None:
         self.context = context
         self.devices: List[DeviceModule] = []
+        self._progressive: Optional[tuple] = None
+        self._sel_epoch = 0      # bumped on add(): invalidates class caches
         self._discover(context)
 
     def _discover(self, context) -> None:
@@ -124,6 +126,8 @@ class DeviceRegistry:
         dev.device_index = len(self.devices)
         dev.attach(self.context)
         self.devices.append(dev)
+        self._progressive = None   # recompute the progress-needing subset
+        self._sel_epoch += 1
         output.debug_verbose(2, "device", f"registered {dev!r}")
         return dev
 
@@ -135,8 +139,15 @@ class DeviceRegistry:
         return self.devices[0]
 
     def progress(self, stream) -> int:
+        # only devices that OVERRIDE progress get polled: the base is a
+        # no-op, and this poll sits in every hot-loop iteration
+        lst = self._progressive
+        if lst is None:
+            lst = self._progressive = tuple(
+                d for d in self.devices
+                if type(d).progress is not DeviceModule.progress)
         n = 0
-        for d in self.devices:
+        for d in lst:
             n += d.progress(stream)
         return n
 
@@ -149,12 +160,30 @@ class DeviceRegistry:
            of availability (load + estimate), with the skew tunable biasing
            toward accelerators.
         """
+        tc = task.task_class
         mask = task.chore_mask & task.taskpool.devices_index_mask
-        chore_types = 0
-        for ch in task.task_class.incarnations:
-            chore_types |= ch.device_type
-        mask &= chore_types
-        candidates = [d for d in self.devices if d.type & mask]
+        # candidate filtering amortizes to a dict hit on the per-task hot
+        # path. The cache lives ON the task class (it dies with the class;
+        # a registry-held cache would pin dead taskpools through their
+        # bound-method chores) and is validated against this registry +
+        # its device epoch, so a class reused across contexts or a
+        # late-registered device can never serve stale candidates
+        cache = tc._dev_sel_cache
+        if cache is not None and cache[0]() is self \
+                and cache[1] == self._sel_epoch:
+            candidates = cache[2].get(mask)
+        else:
+            import weakref
+            cache = (weakref.ref(self), self._sel_epoch, {})
+            tc._dev_sel_cache = cache
+            candidates = None
+        if candidates is None:
+            chore_types = 0
+            for ch in tc.incarnations:
+                chore_types |= ch.device_type
+            candidates = tuple(d for d in self.devices
+                               if d.type & mask & chore_types)
+            cache[2][mask] = candidates
         if not candidates:
             return None
         if len(candidates) == 1:

@@ -71,7 +71,7 @@ class DTDTile:
 
     __slots__ = ("data", "key", "dc", "lock", "last_writer", "readers",
                  "rank", "new_tile", "wcount", "writer_rank",
-                 "last_writer_version")
+                 "last_writer_version", "compact_at")
 
     def __init__(self, data: Data, key: Any, dc: Optional[DataCollection],
                  rank: int = 0, new_tile: bool = False) -> None:
@@ -83,6 +83,7 @@ class DTDTile:
         self.readers: List["DTDTask"] = []
         self.rank = rank
         self.new_tile = new_tile
+        self.compact_at = 32      # next reader-list compaction watermark
         #: logical write sequence number, identical on every rank because all
         #: ranks replay the same insert sequence (the basis remote transfers
         #: are keyed on, standing for the reference's output version tracking)
@@ -99,10 +100,11 @@ class DTDTask(Task):
 
     __slots__ = ("deps_remaining", "successors", "completed", "lock",
                  "arg_spec", "tiles", "rank", "pending_inputs",
-                 "remote_sends")
+                 "remote_sends", "ident")
 
     def __init__(self, taskpool, task_class, priority=0) -> None:
-        super().__init__(taskpool, task_class, {}, priority)
+        super().__init__(taskpool, task_class, None, priority)
+        self.ident = 0          # insertion index (repr/debug identity)
         # starts at 1: the insertion-in-progress guard (dropped at the end of
         # insert_task, mirroring the count-then-activate protocol of
         # parsec_dtd_schedule_task_if_ready, insert_function.c:2963)
@@ -114,15 +116,21 @@ class DTDTask(Task):
         self.tiles: List[Optional[DTDTile]] = []
         self.rank = 0
         #: flow_index -> payload delivered by the comm engine (exact-version
-        #: remote inputs override newest_copy resolution)
-        self.pending_inputs: Dict[int, Any] = {}
-        #: id(tile) -> (tile, version, {dst ranks}) — the rank_sent_to bitmap
-        self.remote_sends: Dict[int, Tuple] = {}
+        #: remote inputs override newest_copy resolution). Lazily allocated:
+        #: only distributed consumers need it, and a per-task dict is
+        #: GC-tracked churn on the insert hot path
+        self.pending_inputs: Optional[Dict[int, Any]] = None
+        #: id(tile) -> (tile, version, {dst ranks}) — the rank_sent_to
+        #: bitmap; lazily allocated for the same reason
+        self.remote_sends: Optional[Dict[int, Tuple]] = None
 
     def dep_satisfied(self) -> bool:
         with self.lock:
             self.deps_remaining -= 1
             return self.deps_remaining == 0
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{self.task_class.name}(#{self.ident})"
 
 
 #: process-wide jit cache keyed by the body function object, so the same body
@@ -296,7 +304,10 @@ class DTDTaskpool(Taskpool):
             tc.prepare_input = self._prepare_input
             tc.release_deps = self._release_deps
             tc.complete_execution = self._complete_execution
-            tc.add_chore(Chore(DEV_TPU, self._tpu_hook))
+            # the TPU chore only exists where a TPU device does — on
+            # CPU-only contexts every task would walk (and fail) it first
+            if any(d.type & DEV_TPU for d in self.ctx.devices.devices):
+                tc.add_chore(Chore(DEV_TPU, self._tpu_hook))
             tc.add_chore(Chore(DEV_CPU, self._cpu_hook))
             self.add_task_class(tc)
             self._classes[key] = tc
@@ -347,7 +358,7 @@ class DTDTaskpool(Taskpool):
             if affinity_tile is None and tiles:
                 affinity_tile = tiles[0]
         task.rank = affinity_tile.rank if affinity_tile is not None else self.ctx.my_rank
-        task.locals = {"id": self.inserted}
+        task.ident = self.inserted
         self.inserted += 1
 
         distributed = self.ctx.comm is not None and self.ctx.nb_ranks > 1
@@ -392,7 +403,21 @@ class DTDTaskpool(Taskpool):
                         (not distributed or tile.last_writer.rank == my):
                     preds.append(tile.last_writer)
                 if not remote:
-                    tile.readers.append(task)
+                    readers = tile.readers
+                    if len(readers) >= tile.compact_at:
+                        # amortized compaction: completed readers are
+                        # already-satisfied WAR predecessors — pruning them
+                        # keeps long read-chains (and the live object
+                        # graph) from growing unboundedly between writes.
+                        # The watermark doubles past the survivors so a
+                        # burst of never-retiring readers costs O(n log n)
+                        # total, not a full rescan per insert
+                        live = [r for r in readers if not r.completed]
+                        live.append(task)
+                        tile.readers = live
+                        tile.compact_at = max(32, 2 * len(live))
+                    else:
+                        readers.append(task)
             if acc & WRITE:
                 # WAR: wait on local readers since the previous write; WAW on
                 # the local last writer (remote ones are covered by the
@@ -407,6 +432,7 @@ class DTDTaskpool(Taskpool):
                     preds.append(tile.last_writer)
                 tile.last_writer = task
                 tile.readers = []
+                tile.compact_at = 32
                 tile.wcount += 1
                 tile.last_writer_version = tile.wcount
                 tile.writer_rank = task.rank
@@ -452,8 +478,9 @@ class DTDTaskpool(Taskpool):
 
     # ------------------------------------------------------------- hooks
     def _prepare_input(self, stream, task: DTDTask) -> int:
+        pending = task.pending_inputs
         for i, tile in enumerate(task.tiles):
-            pend = task.pending_inputs.pop(i, None)
+            pend = pending.pop(i, None) if pending else None
             if pend is not None:
                 # remote exact-version payload (may differ from newest_copy
                 # when versions raced in through the network out of order);
@@ -617,6 +644,15 @@ class DTDTaskpool(Taskpool):
         # before any released successor can rebind the tile's host copy
         if self.ctx.comm is not None:
             self.ctx.comm.dtd_task_completed(self, task)
+        # retire the task's object graph (the mempool-return moment of
+        # parsec_dtd_release_task): dropping the tile/copy references here
+        # lets refcounting reclaim payload buffers immediately and keeps
+        # the completed shell acyclic, so deferred GC at quiescence walks
+        # shells, not the whole DAG
+        task.tiles = ()
+        task.arg_spec = ()
+        task.data = ()
+        task.pending_inputs = None
         ready = [s for s in succs if s.dep_satisfied()]
         if ready:
             self.ctx.schedule(ready, stream)

@@ -289,9 +289,11 @@ class PTGTaskpool(Taskpool):
                                       negate=True, dtt=d.dtt,
                                       dtt_remote=d.dtt_remote)
 
-        # hooks
-        tc.prepare_input = self._mk_prepare_input(tc)
-        tc.complete_execution = self._mk_complete(tc)
+        # hooks — flowless classes (the EP shape) skip the data hooks
+        # entirely instead of paying per-task env construction for nothing
+        tc.prepare_input = self._mk_prepare_input(tc) if tc.flows else None
+        if any(getattr(f, "_ptg_mem_out", None) for f in tc.flows):
+            tc.complete_execution = self._mk_complete(tc)
         nb_bodies = 0
         for body in tcs.bodies:
             fn = self._compile_body(tcs, body)
@@ -565,6 +567,17 @@ class PTGTaskpool(Taskpool):
             oi += 1
 
     def _mk_cpu_hook(self, tc: TaskClass, fn):
+        if not tc.flows:
+            # flowless class (the EP/control-task shape): no arrays flow
+            # through the body, so the jit wrapper is pure dispatch
+            # overhead — run the raw python body
+            raw = getattr(fn, "__wrapped__", fn)
+
+            def flowless_hook(stream, task: Task) -> int:
+                raw(*[task.locals[p] for p in tc._ptg_spec.params])
+                return HOOK_DONE
+            return flowless_hook
+
         def hook(stream, task: Task) -> int:
             outs = fn(*self._body_inputs(tc, task))
             self._store_outputs(tc, task, outs)

@@ -39,6 +39,11 @@ class DotGrapher:
     @staticmethod
     def _label(task) -> str:
         loc = "_".join(str(v) for v in task.locals.values())
+        if not loc:
+            # DTD tasks carry no named locals; their identity is the
+            # insertion index
+            ident = getattr(task, "ident", None)
+            loc = str(ident) if ident is not None else ""
         return f"{task.task_class.name}_{loc}" if loc else task.task_class.name
 
     def _on_exec(self, stream, task, extra) -> None:

@@ -83,19 +83,6 @@ def test_native_zone_matches_python_semantics():
     assert st["largest_hole_bytes"] == 16 << 20
 
 
-def test_native_deque():
-    d = native.NativeDeque()
-    for h in (1, 2, 3):
-        d.push_back(h)
-    d.push_front(99)
-    assert len(d) == 4
-    assert d.pop_front() == 99
-    assert d.pop_back() == 3
-    assert d.pop_front() == 1
-    assert d.pop_front() == 2
-    assert d.pop_front() == 0  # empty sentinel
-
-
 def test_taskpool_uses_native_for_int_keys():
     """PTG-style int-tuple keys ride the native dep engine."""
     from parsec_tpu.core.task import TaskClass, Taskpool
