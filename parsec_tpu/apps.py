@@ -10,6 +10,8 @@
   (tests/apps/pingpong): each hop is a remote dep in distributed mode.
 * :func:`haar_transform` — pairwise averaging/detail tree (the dynamic-tree
   shape of the reference's haar-tree test).
+* :func:`generalized_reduction` — forest-of-binary-trees reduction of an
+  arbitrary tile count (tests/apps/generalized_reduction/BT_reduction.jdf).
 """
 
 from __future__ import annotations
@@ -116,3 +118,55 @@ def haar_transform(tp: DTDTaskpool, leaves: List) -> List:
         level = nxt
         roots.append(level[0])
     return roots
+
+
+def generalized_reduction(tp: DTDTaskpool, tiles: List, op=None) -> "object":
+    """BT_reduction: reduce ANY number of tiles (not just powers of two)
+    through a forest of binary trees plus a linear pass over the roots
+    (ref: tests/apps/generalized_reduction/BT_reduction.jdf — REDUCTION
+    feeds per-tree BT_REDUC levels, tree roots chain through
+    LINEAR_REDUC). The tile count's set bits pick the tree sizes exactly
+    as the reference's index_to_tree/compute_offset helpers do; here the
+    decomposition is plain Python over the replayed insert sequence.
+
+    ``op(left, right) -> combined`` must be associative (the tree
+    reorders associations, like any parallel reduction) but NOT
+    commutative: every pairwise task keeps the lower-index operand on
+    the left, so the result is tiles[0] op tiles[1] op ... in order.
+    Returns the tile holding the final value (the first tree's root —
+    offset 0, where the reference's LINEAR_REDUC(1) chain lands).
+    Distributed: each pairwise task runs at its destination tile's
+    owner; cross-tree edges become remote deps under the normal
+    owner-computes replay.
+    """
+    if op is None:
+        op = _acc_add
+    nt = len(tiles)
+    if nt == 0:
+        raise ValueError("nothing to reduce")
+    # one tree per set bit, LSB first (compute_offset's ordering)
+    trees = []
+    off = 0
+    for bit in range(nt.bit_length()):
+        if (nt >> bit) & 1:
+            trees.append((off, 1 << bit))
+            off += 1 << bit
+    roots = []
+    for off, size in trees:
+        # BT_REDUC levels: each pair combines into its EVEN (left) child,
+        # keeping left-to-right association for non-commutative ops
+        level = [tiles[off + j] for j in range(size)]
+        while len(level) > 1:
+            nxt = []
+            for j in range(0, len(level), 2):
+                a, b = level[j], level[j + 1]
+                tp.insert_task(op, (a, RW), (b, READ), name="bt_reduc")
+                nxt.append(a)
+            level = nxt
+        roots.append(level[0])
+    # LINEAR_REDUC: fold tree roots last -> first (earlier root stays on
+    # the left); result lands at the first tree's root (offset 0)
+    for i in range(len(roots) - 1, 0, -1):
+        tp.insert_task(op, (roots[i - 1], RW), (roots[i], READ),
+                       name="linear_reduc")
+    return roots[0]

@@ -59,7 +59,7 @@ class TPUTask:
 
     __slots__ = ("task", "submit", "stage_in", "stage_out", "pushout",
                  "batchable", "batch_submit", "load", "out_arrays",
-                 "complete_cb", "oom_retries")
+                 "complete_cb", "oom_retries", "pinned")
 
     def __init__(self, task: Task, submit: Callable, stage_in=None,
                  stage_out=None, pushout: int = 0, batchable: bool = False,
@@ -78,6 +78,11 @@ class TPUTask:
         self.out_arrays: Optional[Sequence[Any]] = None
         self.complete_cb: Optional[Callable] = None
         self.oom_retries = 0
+        #: device copies whose ``readers`` count this inflight task holds
+        #: (pinned against eviction between stage-in and epilog, ref:
+        #: the readers guard of parsec_device_data_stage_in/epilog,
+        #: device_gpu.c:1210,1800)
+        self.pinned: List[Any] = []
 
 
 class TPUDevice(DeviceModule):
@@ -103,6 +108,8 @@ class TPUDevice(DeviceModule):
         self._lru_sizes: Dict[Any, int] = {}   # accounted bytes per key
         self._lru_segs: Dict[Any, Any] = {}    # key -> pt_zone segment
         self._resident_bytes = 0
+        self.evictions = 0          # copies evicted (budget pressure stat)
+        self.pinned_skips = 0       # eviction walks that skipped a pinned copy
         budget = mca.get("device_tpu_max_bytes", 0)
         if not budget:
             try:
@@ -270,11 +277,22 @@ class TPUDevice(DeviceModule):
             if data is not None:
                 dev_copy = (gt.stage_in or self._default_stage_in)(data, flow.access)
                 slot.data_in = dev_copy
+                # pin between stage-in and epilog: the eviction walks skip
+                # copies with readers > 0, so an inflight task's inputs
+                # can never be evicted under it (device_gpu.c:1210)
+                dev_copy.readers += 1
+                gt.pinned.append(dev_copy)
                 inputs.append(dev_copy.payload)
             else:
                 payload = getattr(copy_in, "payload", copy_in)
                 inputs.append(self._jax.device_put(payload, self.jax_device))
         return inputs
+
+    def _unpin(self, gt: TPUTask) -> None:
+        """Drop this task's reader pins (epilog or failed submit)."""
+        for copy in gt.pinned:
+            copy.readers -= 1
+        gt.pinned.clear()
 
     def _submit_one_retry(self, gt: TPUTask) -> bool:
         """Submit with the OOM -> evict -> retry -> HOOK_AGAIN discipline of
@@ -284,6 +302,7 @@ class TPUDevice(DeviceModule):
             self._submit_one(gt)
             return True
         except Exception as e:  # noqa: BLE001
+            self._unpin(gt)     # the retry re-gathers (and re-pins) inputs
             if not _is_oom(e):
                 self.load_sub(gt.load)
                 output.fatal(f"TPU submit failed for {gt.task!r}: {e}")
@@ -292,6 +311,7 @@ class TPUDevice(DeviceModule):
                 self._submit_one(gt)
                 return True
             except Exception as e2:  # noqa: BLE001
+                self._unpin(gt)
                 if not _is_oom(e2):
                     self.load_sub(gt.load)
                     output.fatal(f"TPU submit failed for {gt.task!r}: {e2}")
@@ -309,13 +329,17 @@ class TPUDevice(DeviceModule):
         """One dispatch for a batch of compatible independent tasks; ragged
         batches (e.g. boundary tiles of a different shape) fall back to
         per-task submission. Returns the tasks actually dispatched."""
-        inputs_list = [self._gather_inputs(g) for g in group]
         try:
+            inputs_list = [self._gather_inputs(g) for g in group]
             outs_list = group[0].batch_submit(self, [g.task for g in group],
                                               inputs_list)
-        except Exception as e:  # noqa: BLE001 - ragged shapes etc.
+        except Exception as e:  # noqa: BLE001 - ragged shapes, stage-in OOM
             output.debug_verbose(2, "device",
                                  f"batch of {len(group)} fell back: {e}")
+            # unpin EVERY member (a stage-in failure mid-gather leaves
+            # earlier members pinned); per-task retries re-gather + re-pin
+            for g in group:
+                self._unpin(g)
             return [g for g in group if self._submit_one_retry(g)]
         for g, outs in zip(group, outs_list):
             if outs is None:
@@ -360,6 +384,7 @@ class TPUDevice(DeviceModule):
             from ..utils.trace import EVENT_FLAG_END
             ps.trace(self._prof_keys[1], hash(task.key) & 0x7FFFFFFF,
                      task.taskpool.taskpool_id, EVENT_FLAG_END)
+        self._unpin(gt)     # inputs consumed: copies evictable again
         self.executed_tasks += 1
         self.load_sub(gt.load)
         if gt.complete_cb is not None:
@@ -411,6 +436,7 @@ class TPUDevice(DeviceModule):
             for key in list(self._lru):
                 copy = self._lru[key]
                 if copy.readers > 0:
+                    self.pinned_skips += 1
                     continue
                 data = copy.original
                 if data is not None and copy.coherency_state == COHERENCY_OWNED \
@@ -423,6 +449,7 @@ class TPUDevice(DeviceModule):
                     seg.free()
                 copy.coherency_state = COHERENCY_INVALID
                 copy.payload = None
+                self.evictions += 1
                 break
             if self._resident_bytes == before:
                 break
@@ -436,6 +463,7 @@ class TPUDevice(DeviceModule):
             for key in list(self._lru):
                 copy = self._lru[key]
                 if copy.readers > 0:
+                    self.pinned_skips += 1
                     continue
                 data = copy.original
                 if data is not None and copy.coherency_state == COHERENCY_OWNED \
@@ -448,6 +476,7 @@ class TPUDevice(DeviceModule):
                     seg.free()
                 copy.coherency_state = COHERENCY_INVALID
                 copy.payload = None
+                self.evictions += 1
                 evicted = True
                 break
             if not evicted:

@@ -231,6 +231,7 @@ class DTDTaskpool(Taskpool):
         self.threshold_size = mca.get("dtd_threshold_size", 1024)
         self.inserted = 0
         self.local_inserted = 0   # tasks this rank actually executes
+        self.window_stalls = 0    # inserter blocked on the task window
         self._executed = 0
         self._exec_lock = threading.Lock()
         self._open = False
@@ -379,6 +380,7 @@ class DTDTaskpool(Taskpool):
         self._drop_insertion_guard(task, schedule=True)
         # window flow control (ref: insert_function.h:149-157)
         if self.local_inserted - self.executed > self.window_size:
+            self.window_stalls += 1
             target = self.local_inserted - self.threshold_size
             self.ctx.start()
             self.ctx._progress_loop(self.ctx.streams[0],

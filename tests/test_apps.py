@@ -288,3 +288,81 @@ def test_stencil3d_distributed():
     out = np.concatenate([full[z] for z in range(NZ)], axis=0)
     np.testing.assert_allclose(out, reference_stencil3d(dense, ITERS),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_generalized_reduction_non_power_of_two():
+    """Forest-of-binary-trees reduction of 13 tiles (0b1101: trees of
+    1+4+8) — the BT_reduction shape; exactly nt-1 pairwise tasks."""
+    import parsec_tpu as pt
+    from parsec_tpu.apps import generalized_reduction
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    ctx = pt.Context(nb_cores=1)
+    rng = np.random.default_rng(77)
+    vals = rng.standard_normal((13, 8)).astype(np.float32)
+    tp = DTDTaskpool(ctx, "genred")
+    tiles = [tp.tile_new(vals[i]) for i in range(13)]
+    n0 = tp.inserted
+    root = generalized_reduction(tp, tiles)
+    assert tp.inserted - n0 == 12
+    tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30)
+    out = np.asarray(root.data.newest_copy().payload)
+    np.testing.assert_allclose(out, vals.sum(axis=0), rtol=1e-5, atol=1e-5)
+    ctx.fini()
+
+
+def _genred_distributed(rank, fabric):
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.apps import generalized_reduction
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+    RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+    nt = 11             # 0b1011: trees of 1 + 2 + 8
+    A = TwoDimBlockCyclic("GR", 4 * nt, 4, 4, 4, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    A.fill(lambda m, n: np.full((4, 4), float(m + 1), np.float32))
+    tp = DTDTaskpool(ctx, "genred2")
+    tiles = [tp.tile_of(A, m, 0) for m in range(nt)]
+    root = generalized_reduction(tp, tiles)
+    tp.wait(timeout=60); tp.close(); ctx.wait(timeout=60)
+    out = None
+    if root.rank == rank:
+        out = float(np.asarray(root.data.newest_copy().payload)[0, 0])
+    ctx.fini()
+    return out
+
+
+def test_generalized_reduction_distributed():
+    """2-rank BT_reduction: tree edges cross ranks (row-cyclic tiles)."""
+    from parsec_tpu.comm.threads import run_distributed
+    results = run_distributed(2, _genred_distributed, timeout=90)
+    got = [r for r in results if r is not None]
+    assert got and got[0] == sum(range(1, 12))   # 1+2+...+11 = 66
+
+
+def _matmul_red(left, right):
+    return left @ right
+
+
+def test_generalized_reduction_non_commutative_op():
+    """Association order is left-to-right: an associative but
+    NON-commutative op (matrix product) over 5 tiles (0b101) must give
+    tiles[0] @ tiles[1] @ ... @ tiles[4]."""
+    import functools
+    import parsec_tpu as pt
+    from parsec_tpu.apps import generalized_reduction
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    ctx = pt.Context(nb_cores=1)
+    rng = np.random.default_rng(88)
+    mats = [rng.standard_normal((4, 4)).astype(np.float32) * 0.5
+            for _ in range(5)]
+    tp = DTDTaskpool(ctx, "genred-mm")
+    tiles = [tp.tile_new(m) for m in mats]
+    root = generalized_reduction(tp, tiles, op=_matmul_red)
+    tp.wait(timeout=30); tp.close(); ctx.wait(timeout=30)
+    out = np.asarray(root.data.newest_copy().payload)
+    ref = functools.reduce(lambda a, b: a @ b, mats)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    ctx.fini()
