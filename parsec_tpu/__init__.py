@@ -57,6 +57,10 @@ _LAZY = {
     "compile_ptg": ("parsec_tpu.dsl.ptg.compiler", "compile_ptg"),
     "TiledMatrix": ("parsec_tpu.data.matrix", "TiledMatrix"),
     "TwoDimBlockCyclic": ("parsec_tpu.data.matrix", "TwoDimBlockCyclic"),
+    "SymTwoDimBlockCyclic": ("parsec_tpu.data.matrix", "SymTwoDimBlockCyclic"),
+    "SymTwoDimBlockCyclicBand": ("parsec_tpu.data.matrix", "SymTwoDimBlockCyclicBand"),
+    "SBCDistribution": ("parsec_tpu.data.matrix", "SBCDistribution"),
+    "VectorTwoDimCyclic": ("parsec_tpu.data.matrix", "VectorTwoDimCyclic"),
     "NamedDatatype": ("parsec_tpu.data.reshape", "NamedDatatype"),
     "RemoteDepEngine": ("parsec_tpu.comm.remote_dep", "RemoteDepEngine"),
     "ThreadsCE": ("parsec_tpu.comm.threads", "ThreadsCE"),

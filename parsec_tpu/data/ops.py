@@ -94,6 +94,69 @@ def reduce_col(tp: DTDTaskpool, A: TiledMatrix,
     return tp.inserted - n0
 
 
+def diag_band_to_rect(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix) -> int:
+    """Pack the diagonal band of a symmetric (lower) tiled matrix into a 1D
+    row of rectangular tiles (ref: diag_band_to_rect.jdf).
+
+    For each tile column k, output tile B(0, k) of shape (MB+1, NB+2) packs
+    global column j of the band: the diagonal tile's column from the
+    diagonal down, then the subdiagonal tile's top rows — the LAPACK
+    band-storage layout used between band reduction and bulge chasing in
+    eigensolvers. The trailing two columns (and a trailing padding tile,
+    when B has NT+1 column-tiles) are zeroed, mirroring the reference's
+    k == NT branch.
+
+    A must have square tiles (MB == NB); B(0, k) tiles must be
+    (MB+1) × (NB+2). Each convert task carries read deps on A(k,k) and
+    A(k+1,k), so in distributed runs the band tiles flow to B's owner rank
+    through the regular remote-dep protocol (the JDF's read_diag /
+    read_subdiag relay tasks exist only to home the sends; DTD's
+    owner-computes affinity gives the same placement directly).
+    """
+    mb, nb = A.mb, A.nb
+    if mb != nb:
+        raise ValueError("diag_band_to_rect requires square tiles (MB == NB)")
+    if A.lm % mb or A.ln % nb:
+        raise ValueError("diag_band_to_rect requires full tiles "
+                         f"({A.lm}x{A.ln} not divisible by {mb}x{nb})")
+    nt = min(A.mt, A.nt)
+    if B.tile_shape(0, 0) != (mb + 1, nb + 2):
+        raise ValueError(f"B tiles must be ({mb + 1},{nb + 2}), "
+                         f"got {B.tile_shape(0, 0)}")
+
+    def convert(b, d, sd):
+        out = np.zeros_like(np.asarray(b))
+        dd = np.asarray(d)
+        for j in range(nb):
+            out[:mb - j, j] = dd[j:mb, j]
+            if sd is not None:
+                out[mb - j:mb + 1, j] = np.asarray(sd)[:j + 1, j]
+        return out
+
+    def convert_last(b, d):
+        return convert(b, d, None)
+
+    def zero_pad(b):
+        return np.zeros_like(np.asarray(b))
+
+    n0 = tp.inserted
+    for k in range(nt):
+        if k < nt - 1:
+            tp.insert_task(convert, (tp.tile_of(B, 0, k), RW | AFFINITY),
+                           (tp.tile_of(A, k, k), READ),
+                           (tp.tile_of(A, k + 1, k), READ),
+                           name="convert_diag", jit=False)
+        else:
+            tp.insert_task(convert_last, (tp.tile_of(B, 0, k), RW | AFFINITY),
+                           (tp.tile_of(A, k, k), READ),
+                           name="convert_diag", jit=False)
+    if B.nt > nt:  # padding tile(s), ref's k == NT branch
+        for k in range(nt, B.nt):
+            tp.insert_task(zero_pad, (tp.tile_of(B, 0, k), RW | AFFINITY),
+                           name="convert_pad", jit=False)
+    return tp.inserted - n0
+
+
 def broadcast(tp: DTDTaskpool, A: TiledMatrix, root: tuple = (0, 0)) -> int:
     """Copy tile ``root`` into every tile of A (ref: broadcast.jdf).
 

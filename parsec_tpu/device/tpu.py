@@ -425,33 +425,36 @@ class TPUDevice(DeviceModule):
             if seg is not None:
                 self._lru_segs[key] = seg
 
+    def _evict_one(self) -> bool:
+        """Evict the least-recently-used unpinned copy (dirty copies are
+        written back first). Returns False when everything is pinned."""
+        for key in list(self._lru):
+            copy = self._lru[key]
+            if copy.readers > 0:
+                self.pinned_skips += 1
+                continue
+            data = copy.original
+            if data is not None and copy.coherency_state == COHERENCY_OWNED \
+                    and data.newest_copy() is copy:
+                self._stage_out(data, copy)   # dirty: write back first
+            self._lru.pop(key)
+            self._resident_bytes -= self._lru_sizes.pop(key, 0)
+            seg = self._lru_segs.pop(key, None)
+            if seg is not None:
+                seg.free()
+            copy.coherency_state = COHERENCY_INVALID
+            copy.payload = None
+            self.evictions += 1
+            return True
+        return False
+
     def evict_bytes(self, nbytes: int) -> int:
         """Force eviction of about ``nbytes`` of resident clean/dirty copies
         (the explicit half of the OOM retry path)."""
         target = max(0, self._resident_bytes - nbytes)
         freed0 = self._resident_bytes
         while self._resident_bytes > target and self._lru:
-            before = self._resident_bytes
-            # evict the least-recently-used unpinned copy
-            for key in list(self._lru):
-                copy = self._lru[key]
-                if copy.readers > 0:
-                    self.pinned_skips += 1
-                    continue
-                data = copy.original
-                if data is not None and copy.coherency_state == COHERENCY_OWNED \
-                        and data.newest_copy() is copy:
-                    self._stage_out(data, copy)
-                self._lru.pop(key)
-                self._resident_bytes -= self._lru_sizes.pop(key, 0)
-                seg = self._lru_segs.pop(key, None)
-                if seg is not None:
-                    seg.free()
-                copy.coherency_state = COHERENCY_INVALID
-                copy.payload = None
-                self.evictions += 1
-                break
-            if self._resident_bytes == before:
+            if not self._evict_one():
                 break
         return freed0 - self._resident_bytes
 
@@ -459,27 +462,7 @@ class TPUDevice(DeviceModule):
         """Evict LRU copies until ``nbytes`` fits the budget
         (ref: parsec_device_data_reserve_space device_gpu.c:1210)."""
         while self._resident_bytes + nbytes > self._budget and self._lru:
-            evicted = False
-            for key in list(self._lru):
-                copy = self._lru[key]
-                if copy.readers > 0:
-                    self.pinned_skips += 1
-                    continue
-                data = copy.original
-                if data is not None and copy.coherency_state == COHERENCY_OWNED \
-                        and data.newest_copy() is copy:
-                    self._stage_out(data, copy)   # dirty: write back first
-                self._lru.pop(key)
-                self._resident_bytes -= self._lru_sizes.pop(key, 0)
-                seg = self._lru_segs.pop(key, None)
-                if seg is not None:
-                    seg.free()
-                copy.coherency_state = COHERENCY_INVALID
-                copy.payload = None
-                self.evictions += 1
-                evicted = True
-                break
-            if not evicted:
+            if not self._evict_one():
                 break  # everything pinned; rely on XLA allocator
 
     def zone_stats(self) -> Dict[str, int]:
