@@ -29,6 +29,7 @@ XLA/PJRT execution model:
 from __future__ import annotations
 
 import collections
+import os
 import threading
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -507,6 +508,11 @@ def _nbytes(arr) -> int:
 mca.register("device_discovery_timeout_s", 45,
              "Give up on accelerator discovery after this many seconds", type=int)
 
+# rank→chip binding handed down by the launcher: index into this process's
+# local device list (ref: the mpiexec + one-GPU-per-rank production shape,
+# tests/CMakeLists.txt:1032-1042)
+ENV_LOCAL_DEVICE = "PARSEC_TPU_LOCAL_DEVICE"
+
 
 def discover_tpu_devices() -> List[TPUDevice]:
     """Enumerate local accelerator chips through JAX (ref: device discovery,
@@ -521,20 +527,30 @@ def discover_tpu_devices() -> List[TPUDevice]:
     result: List[TPUDevice] = []
     done = threading.Event()
     over_cpu = mca.get("device_tpu_over_cpu", False)
+    # launcher-provided rank→chip binding (the mpiexec + CUDA_VISIBLE_DEVICES
+    # role): each process binds exactly its local device i instead of
+    # claiming every chip on the host
+    bind = os.environ.get(ENV_LOCAL_DEVICE)
 
     def _probe() -> None:
         try:
-            cpus = []
+            accels, cpus = [], []
             for d in jax.devices():
                 if d.platform in ("tpu", "gpu", "axon"):
-                    result.append(TPUDevice(d))
+                    accels.append(d)
                 elif over_cpu and d.platform == "cpu":
                     cpus.append(d)
-            if not result and cpus:
+            if accels:
+                if bind is not None:
+                    result.append(TPUDevice(accels[int(bind) % len(accels)]))
+                else:
+                    result.extend(TPUDevice(d) for d in accels)
+            elif cpus:
                 # test mode: drive the full async device pipeline (stage-in,
                 # LRU, events, batching) over one host device — selectable so
                 # oversubscribed ranks can spread over a virtual device mesh
-                idx = mca.get("device_tpu_over_cpu_index", 0) % len(cpus)
+                idx = (int(bind) if bind is not None
+                       else mca.get("device_tpu_over_cpu_index", 0)) % len(cpus)
                 result.append(TPUDevice(cpus[idx]))
         except Exception as e:
             output.debug_verbose(1, "device", f"jax.devices() failed: {e}")

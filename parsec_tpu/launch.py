@@ -27,6 +27,16 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend on every rank (no probe)")
+    ap.add_argument("--bind-devices", action="store_true",
+                    help="bind rank i to local accelerator chip i "
+                         "(PARSEC_TPU_LOCAL_DEVICE=i; ranks beyond the chip "
+                         "count fall back to CPU)")
+    ap.add_argument("--virtual-devices", type=int, default=0, metavar="N",
+                    help="give every rank N virtual CPU devices "
+                         "(--xla_force_host_platform_device_count) and bind "
+                         "rank i to device i%%N through the TPU device module "
+                         "— the production process-per-rank/chip-per-process "
+                         "shape, rehearsed without chips")
     ap.add_argument("script")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     opts = ap.parse_args(argv)
@@ -34,16 +44,18 @@ def main(argv=None) -> int:
     # one accelerator decision for the whole job, made HERE: ranks must never
     # probe concurrently (a single-session TPU transport wedges under
     # multiple clients), and a lone chip belongs to rank 0 only
-    accel_ok = False
-    if not opts.cpu:
+    accel_ok, accel_count = False, 0
+    if not opts.cpu and not opts.virtual_devices:
         try:
             p = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
+                 "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
                 capture_output=True, text=True, timeout=90)
-            plat = (p.stdout.strip().splitlines()[-1]
+            last = (p.stdout.strip().splitlines()[-1]
                     if p.returncode == 0 and p.stdout.strip() else "")
+            plat, _, cnt = last.partition(" ")
             accel_ok = plat in ("tpu", "axon", "gpu")
+            accel_count = int(cnt) if accel_ok and cnt.isdigit() else 0
         except Exception:
             accel_ok = False
 
@@ -54,7 +66,17 @@ def main(argv=None) -> int:
         env[ENV_RANK] = str(rank)
         env[ENV_NPROCS] = str(opts.nprocs)
         env[ENV_RDV] = rdv
-        if not accel_ok or rank > 0:
+        if opts.virtual_devices:
+            # rehearse the chip-per-process shape over virtual CPU devices
+            n = opts.virtual_devices
+            flag = f"--xla_force_host_platform_device_count={n}"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+            env["PARSEC_TPU_FORCE_CPU"] = "1"
+            env["PARSEC_MCA_device_tpu_over_cpu"] = "1"
+            env["PARSEC_TPU_LOCAL_DEVICE"] = str(rank % n)
+        elif opts.bind_devices and accel_ok and rank < max(accel_count, 1):
+            env["PARSEC_TPU_LOCAL_DEVICE"] = str(rank)
+        elif not accel_ok or rank > 0:
             env["PARSEC_TPU_FORCE_CPU"] = "1"
         # each rank leads its own process group so cleanup can reach
         # grandchildren even if the launcher itself is killed mid-wait

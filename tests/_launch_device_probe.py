@@ -1,0 +1,64 @@
+"""Child script for the launcher --virtual-devices test: joins the TCP mesh,
+reports which device the TPU module bound, and runs a tiny DTD GEMM through
+it. Launched by tests/test_tcp_distributed.py via
+
+    python -m parsec_tpu.launch -n 2 --virtual-devices 2 tests/_launch_device_probe.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    if os.environ.get("PARSEC_TPU_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.tcp import init_from_env
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.device.tpu import TPUDevice
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    from parsec_tpu.ops.gemm import insert_gemm_tasks
+
+    ce = init_from_env()
+    ctx = Context(nb_cores=1, my_rank=ce.my_rank, nb_ranks=ce.nb_ranks)
+    RemoteDepEngine(ctx, ce)
+    tpus = [d for d in ctx.devices.devices if isinstance(d, TPUDevice)]
+
+    n, ts = 32, 16
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    kw = dict(nodes=ce.nb_ranks, myrank=ce.my_rank, P=ce.nb_ranks, Q=1)
+    A = TwoDimBlockCyclic("A", n, n, ts, ts, **kw)
+    B = TwoDimBlockCyclic("B", n, n, ts, ts, **kw)
+    C = TwoDimBlockCyclic("C", n, n, ts, ts, **kw)
+    A.fill(lambda m, k: a[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    B.fill(lambda m, k: np.eye(ts, dtype=np.float32) if m == k
+           else np.zeros((ts, ts), np.float32))
+    C.fill(lambda m, k: np.zeros((ts, ts), np.float32))
+    tp = DTDTaskpool(ctx, "probe-gemm")
+    insert_gemm_tasks(tp, A, B, C)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=60)
+    ctx.fini()
+
+    err = max((float(np.abs(np.asarray(C.data_of(m, k).newest_copy().payload)
+                            - a[m*ts:(m+1)*ts, k*ts:(k+1)*ts]).max())
+               for m in range(n//ts) for k in range(n//ts)
+               if C.rank_of(m, k) == ce.my_rank), default=0.0)
+    executed = sum(d.executed_tasks for d in tpus)
+    print(f"PROBE rank={ce.my_rank} devices={[d.jax_device.id for d in tpus]} "
+          f"executed={executed} err={err:.2e}", flush=True)
+    ce.sync()
+    ce.fini()
+    assert err < 1e-3
+    assert len(tpus) == 1 and executed > 0
+
+
+if __name__ == "__main__":
+    main()
