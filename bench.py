@@ -65,8 +65,11 @@ def main() -> None:
         from parsec_tpu.ops.pallas_kernels import verify_lowering
         log(f"pallas lowering gate: {verify_lowering()}")
 
+    # TS=2048 on the chip: 16 fused k-chain tasks — wide enough for a real
+    # DAG, few enough dispatches that the relay's ~4ms per-dispatch protocol
+    # cost does not dominate the MXU time
     N = 8192 if on_tpu else 2048
-    TS = 1024 if on_tpu else 512
+    TS = 2048 if on_tpu else 512
     reps = 3 if on_tpu else 2
 
     import jax.numpy as jnp
@@ -74,54 +77,106 @@ def main() -> None:
     a_host = rng.standard_normal((N, N)).astype(np.float32)
     b_host = rng.standard_normal((N, N)).astype(np.float32)
 
-    # ---- raw XLA baseline on the same chip --------------------------------
-    dot = jax.jit(lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32))
-    a_dev = jax.device_put(a_host, devs[0])
-    b_dev = jax.device_put(b_host, devs[0])
-    dot(a_dev, b_dev).block_until_ready()  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = dot(a_dev, b_dev)
-    out.block_until_ready()
-    raw_s = (time.perf_counter() - t0) / reps
+    # headline dtype: bf16 tiles on the real chip (MXU-native single-pass,
+    # the peak-FLOPs path BASELINE.md targets), f32 on the CPU proxy (bf16
+    # is emulated there). The correctness gates below always run f32 at
+    # 'highest' MXU precision — dgemm semantics.
+    bench_dtype = jnp.bfloat16 if on_tpu else np.float32
+    a_bench = a_host.astype(bench_dtype) if on_tpu else a_host
+    b_bench = b_host.astype(bench_dtype) if on_tpu else b_host
+
+    # ---- raw XLA baseline on the same chip, same dtype --------------------
+    # TIMING DISCIPLINE (tpu-via-relay): on the tunneled chip BOTH
+    # block_until_ready() and is_ready() return before the computation is
+    # done, and ad-hoc fetches pay a ~100ms protocol round-trip (plus
+    # multi-second compiles the first time). Every measurement therefore
+    # (a) forces completion with a PRE-COMPILED scalar-fetch barrier, and
+    # (b) uses SLOPE timing — T(long chain) - T(short chain) — so the fixed
+    # round-trip/barrier cost cancels. On CPU the same code is simply exact.
+    import functools as _ft
+
+    fetch_scalar = jax.jit(lambda x: x[:1, :1].astype(jnp.float32))
+
+    def force(x):
+        """True completion barrier: materialize one element on the host."""
+        return np.asarray(jax.device_get(fetch_scalar(x)))
+
+    def _timeit(f):
+        t0 = time.perf_counter()
+        f()
+        return time.perf_counter() - t0
+
+    @_ft.partial(jax.jit, static_argnums=2)
+    def _dot_chain(x, b, k):
+        def step(x, _):
+            return jnp.dot(x, b, preferred_element_type=jnp.float32
+                           ).astype(x.dtype), None
+        out, _ = jax.lax.scan(step, x, None, length=k)
+        return out
+
+    a_dev = jax.device_put(a_bench, devs[0])
+    # scaled so chained products stay in range without per-step norm ops
+    b_dev = jax.device_put((b_host / 128.0).astype(bench_dtype), devs[0])
+    k_lo, k_hi = (4, 24) if on_tpu else (1, 3)
+    for k in (k_lo, k_hi):                       # compile + warm both
+        force(_dot_chain(a_dev, b_dev, k))
+
+    def timed_chain(k):
+        t0 = time.perf_counter()
+        force(_dot_chain(a_dev, b_dev, k))
+        return time.perf_counter() - t0
+
+    t_lo = min(timed_chain(k_lo) for _ in range(reps))
+    t_hi = min(timed_chain(k_hi) for _ in range(reps))
+    raw_s = max((t_hi - t_lo) / (k_hi - k_lo), 1e-9)
     raw_gflops = gemm_flops(N, N, N) / 1e9 / raw_s
-    log(f"raw XLA dot: {raw_s*1e3:.2f} ms -> {raw_gflops:.1f} GFLOP/s")
+    log(f"raw XLA dot ({jnp.dtype(bench_dtype).name}, slope {k_lo}->{k_hi}): "
+        f"{raw_s*1e3:.2f} ms -> {raw_gflops:.1f} GFLOP/s")
 
     # ---- the task runtime -------------------------------------------------
     ctx = pt.Context(nb_cores=1)
     mt = N // TS
 
     def mk(dcname, fill):
-        M = TwoDimBlockCyclic(dcname, N, N, TS, TS, P=1, Q=1)
+        M = TwoDimBlockCyclic(dcname, N, N, TS, TS, P=1, Q=1,
+                              dtype=bench_dtype)
         M.fill(fill)
         return M
 
-    A = mk("A", lambda m, n: a_host[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
-    B = mk("B", lambda m, n: b_host[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
-    C = mk("C", lambda m, n: np.zeros((TS, TS), np.float32))
+    A = mk("A", lambda m, n: a_bench[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
+    B = mk("B", lambda m, n: b_bench[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
+    C = mk("C", lambda m, n: np.zeros((TS, TS), np.float32).astype(bench_dtype))
 
-    def run_once() -> float:
+    # one fused barrier over every output tile: a single pre-compiled fetch
+    # forces completion of the whole DAG with ONE round-trip
+    fuse_all = jax.jit(
+        lambda ts: sum(t[0, 0].astype(jnp.float32) for t in ts))
+
+    def run_dags(n_dags: int) -> float:
+        """Insert the full tile-GEMM DAG n times into one taskpool (RW
+        chains on C serialize the repetitions per tile — steady state),
+        then force true completion. Returns wall seconds."""
         tp = DTDTaskpool(ctx, "gemm")
         t0 = time.perf_counter()
-        insert_gemm_tasks(tp, A, B, C, batch_k=True)
+        for _ in range(n_dags):
+            insert_gemm_tasks(tp, A, B, C, batch_k=True)
         tp.wait()
         tp.close()
         ctx.wait()
-        # JAX dispatch is async: block on every output tile before stopping
-        # the clock
-        for m in range(mt):
-            for n in range(mt):
-                p = C.data_of(m, n).newest_copy().payload
-                if hasattr(p, "block_until_ready"):
-                    p.block_until_ready()
+        s = fuse_all([jnp.asarray(C.data_of(m, n).newest_copy().payload)
+                      for m in range(mt) for n in range(mt)])
+        np.asarray(jax.device_get(s))
         return time.perf_counter() - t0
 
-    run_once()          # warm: compiles the fused chain, stages tiles into HBM
-    times = [run_once() for _ in range(reps)]
-    best_s = min(times)
+    run_dags(1)          # warm: compiles chain + barrier, stages tiles to HBM
+    d_lo, d_hi = 1, 3
+    t_lo = min(run_dags(d_lo) for _ in range(reps))
+    t_hi = min(run_dags(d_hi) for _ in range(reps))
+    best_s = max((t_hi - t_lo) / (d_hi - d_lo), 1e-9)
     gflops = gemm_flops(N, N, N) / 1e9 / best_s
-    log(f"DTD tiled GEMM N={N} TS={TS}: {best_s*1e3:.2f} ms -> {gflops:.1f} GFLOP/s "
-        f"(runs: {[f'{t*1e3:.1f}ms' for t in times]})")
+    log(f"DTD tiled GEMM N={N} TS={TS} (slope {d_lo}->{d_hi} DAGs): "
+        f"{best_s*1e3:.2f} ms -> {gflops:.1f} GFLOP/s "
+        f"(T1 {t_lo*1e3:.1f} ms, T3 {t_hi*1e3:.1f} ms)")
 
     # small-size correctness gate (separate matrices, same code path)
     def mk_small(dcname, src):
@@ -144,35 +199,57 @@ def main() -> None:
     pN = N // 2          # SPD factorization at half the GEMM size
     pTS = TS // 2
     spd = make_spd(pN, seed=7)
-    raw_chol = jax.jit(lambda x: jnp.linalg.cholesky(x))
-    spd_dev = jax.device_put(spd, devs[0])
-    raw_chol(spd_dev).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = raw_chol(spd_dev)
-    out.block_until_ready()
-    potrf_flops = pN ** 3 / 3.0
-    raw_potrf_gflops = potrf_flops / 1e9 / ((time.perf_counter() - t0) / reps)
+    @_ft.partial(jax.jit, static_argnums=1)
+    def _chol_chain(x, k):
+        # same f32 'highest' MXU precision as the runtime's tile bodies;
+        # re-symmetrize between steps so every iteration does the same work
+        with jax.default_matmul_precision("highest"):
+            def step(x, _):
+                l = jnp.linalg.cholesky(x)
+                # perturb negligibly so XLA cannot dead-code the cholesky
+                return x + 1e-30 * l, None
+            out, _ = jax.lax.scan(step, x, None, length=k)
+            return out
 
-    def run_potrf() -> float:
-        P = TwoDimBlockCyclic(f"P{time.monotonic_ns()}", pN, pN, pTS, pTS,
-                              P=1, Q=1)
-        P.fill(lambda m, k: spd[m*pTS:(m+1)*pTS, k*pTS:(k+1)*pTS])
+    spd_dev = jax.device_put(spd, devs[0])
+    ck_lo, ck_hi = (1, 3)
+    for k in (ck_lo, ck_hi):
+        force(_chol_chain(spd_dev, k))
+    t_lo = min(_timeit(lambda: force(_chol_chain(spd_dev, ck_lo)))
+               for _ in range(reps))
+    t_hi = min(_timeit(lambda: force(_chol_chain(spd_dev, ck_hi)))
+               for _ in range(reps))
+    potrf_flops = pN ** 3 / 3.0
+    raw_potrf_s = max((t_hi - t_lo) / (ck_hi - ck_lo), 1e-9)
+    raw_potrf_gflops = potrf_flops / 1e9 / raw_potrf_s
+
+    Pm = TwoDimBlockCyclic("Pbench", pN, pN, pTS, pTS, P=1, Q=1)
+    pmt = pN // pTS
+    fuse_tril = jax.jit(
+        lambda ts: sum(t[0, 0].astype(jnp.float32) for t in ts))
+
+    def run_potrf(n_dags: int) -> float:
+        """Repeated in-place factorization DAGs in one taskpool: WAW chains
+        serialize the reps, so the slope isolates ONE critical path. (The
+        re-factorization of a factor is numerical nonsense — NaNs — but
+        op-count and dataflow are identical, which is what the clock sees.)"""
+        Pm.fill(lambda m, k: spd[m*pTS:(m+1)*pTS, k*pTS:(k+1)*pTS])
         tp = DTDTaskpool(ctx, "potrf")
         t0 = time.perf_counter()
-        insert_potrf_tasks(tp, P)
+        for _ in range(n_dags):
+            insert_potrf_tasks(tp, Pm)
         tp.wait(); tp.close(); ctx.wait()
-        for m in range(pN // pTS):
-            for k in range(m + 1):
-                p = P.data_of(m, k).newest_copy().payload
-                if hasattr(p, "block_until_ready"):
-                    p.block_until_ready()
+        s = fuse_tril([jnp.asarray(Pm.data_of(m, k).newest_copy().payload)
+                       for m in range(pmt) for k in range(m + 1)])
+        np.asarray(jax.device_get(s))
         return time.perf_counter() - t0
 
-    run_potrf()   # warm
-    potrf_s = min(run_potrf() for _ in range(reps))
+    run_potrf(1)   # warm
+    pt_lo = min(run_potrf(1) for _ in range(reps))
+    pt_hi = min(run_potrf(3) for _ in range(reps))
+    potrf_s = max((pt_hi - pt_lo) / 2, 1e-9)
     potrf_gflops = potrf_flops / 1e9 / potrf_s
-    log(f"DTD tiled POTRF N={pN} TS={pTS}: {potrf_s*1e3:.2f} ms -> "
+    log(f"DTD tiled POTRF N={pN} TS={pTS} (slope): {potrf_s*1e3:.2f} ms -> "
         f"{potrf_gflops:.1f} GFLOP/s (raw XLA cholesky: "
         f"{raw_potrf_gflops:.1f})")
 
@@ -247,10 +324,27 @@ def main() -> None:
     log(f"EP scaling (PTG tasks/s by nb_cores, host cores="
         f"{os.cpu_count()}): {scaling}")
 
+    # per-dispatch protocol cost of this chip path (diagnostic: on the
+    # tunneled chip this is ~1000x a local PJRT dispatch and bounds any
+    # task-runtime's DAG rate; recorded so the GFLOP/s numbers are readable)
+    tiny = jax.jit(lambda x: x + 1.0)
+    xs = jax.device_put(np.zeros((8, 128), np.float32), devs[0])
+    force(tiny(xs))
+    t0 = time.perf_counter()
+    y = xs
+    for _ in range(20):
+        y = tiny(y)
+    dispatch_ms = (time.perf_counter() - t0) / 20 * 1e3
+    log(f"chained dispatch cost: {dispatch_ms:.2f} ms/call")
+
     print(json.dumps({
         "metric": "tiled-gemm-gflops",
         "value": round(gflops, 1),
         "unit": "GFLOP/s",
+        "platform": devs[0].platform,
+        "gemm_dtype": jnp.dtype(bench_dtype).name,
+        "timing": "slope+forced-barrier",
+        "dispatch_ms": round(dispatch_ms, 3),
         "vs_baseline": round(gflops / raw_gflops, 4),
         "potrf_gflops": round(potrf_gflops, 1),
         "potrf_vs_baseline": round(potrf_gflops / raw_potrf_gflops, 4),

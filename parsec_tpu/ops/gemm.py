@@ -26,7 +26,9 @@ def tile_gemm(c, a, b):
     """C += A @ B on one tile triple; f32 accumulation even for bf16 inputs
     (MXU-native mixed precision)."""
     import jax.numpy as jnp
-    return c + jnp.dot(a, b, preferred_element_type=jnp.float32).astype(c.dtype)
+    from .pallas_kernels import dot_precision
+    return c + jnp.dot(a, b, precision=dot_precision(),
+                       preferred_element_type=jnp.float32).astype(c.dtype)
 
 
 def tile_gemm_chain(c, a_stack, b_stack):
@@ -44,12 +46,15 @@ def tile_gemm_chain(c, a_stack, b_stack):
 
 def insert_gemm_tasks(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,
                       C: TiledMatrix, alpha: float = 1.0,
-                      batch_k: bool = False) -> int:
+                      batch_k: bool = False, batch: bool = False) -> int:
     """Insert the tile-GEMM DAG: C[m,n] += alpha * sum_k A[m,k] B[k,n].
 
     With ``batch_k`` the whole k-chain per C tile becomes ONE task using the
     fused scan body — fewer, bigger device dispatches (the TPU-first answer
-    to per-tile task overhead).
+    to per-tile task overhead). ``batch`` additionally marks the tasks
+    batchable so the device module may collapse up to device_tpu_batch_max
+    compatible ready tasks into one vmapped dispatch (essential when
+    per-dispatch latency is high, e.g. a remote chip).
     Returns the number of inserted tasks.
     """
     mt, nt, kt = C.mt, C.nt, A.nt
@@ -63,7 +68,7 @@ def insert_gemm_tasks(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,
                 args = [(tp.tile_of(C, m, n), RW | AFFINITY)]
                 args += [(tp.tile_of(A, m, k), READ) for k in range(kt)]
                 args += [(tp.tile_of(B, k, n), READ) for k in range(kt)]
-                tp.insert_task(gemm_k, *args, name="GEMM_K")
+                tp.insert_task(gemm_k, *args, name="GEMM_K", batch=batch)
     else:
         for m in range(mt):
             for n in range(nt):
@@ -72,7 +77,7 @@ def insert_gemm_tasks(tp: DTDTaskpool, A: TiledMatrix, B: TiledMatrix,
                     tp.insert_task(tile_gemm, (tc, RW | AFFINITY),
                                    (tp.tile_of(A, m, k), READ),
                                    (tp.tile_of(B, k, n), READ),
-                                   name="GEMM")
+                                   name="GEMM", batch=batch)
     return tp.inserted - n0
 
 
@@ -86,9 +91,10 @@ def _gemm_chain_body(kt: int):
     Pallas VMEM-resident kernel."""
     def gemm_k(c, *abs_):
         import jax.numpy as jnp
+        from .pallas_kernels import dot_precision
         if kt <= 16:
             for k in range(kt):
-                c = c + jnp.dot(abs_[k], abs_[kt + k],
+                c = c + jnp.dot(abs_[k], abs_[kt + k], precision=dot_precision(),
                                 preferred_element_type=jnp.float32
                                 ).astype(c.dtype)
             return c

@@ -31,6 +31,23 @@ mca.register("pallas_strict", False,
              "Fail loudly instead of falling back to XLA when a Pallas "
              "kernel cannot lower/run (the CI compile gate)", type=bool)
 
+mca.register("tile_dot_precision", "highest",
+             "MXU pass count for float32 tile dots: 'default' (fast bf16 "
+             "passes), 'high' (3-pass), 'highest' (6-pass, dgemm-accuracy "
+             "f32). bf16 inputs are always single-pass native.", type=str)
+
+
+def dot_precision():
+    """The lax.Precision for f32 tile dots. On TPU the MXU multiplies in
+    bf16; 'highest' recovers f32 accuracy via 6 passes — the semantics a
+    dgemm-parity runtime must default to. bf16 tiles ignore this (native)."""
+    import jax
+    name = str(mca.get("tile_dot_precision", "highest")).lower()
+    return {"default": jax.lax.Precision.DEFAULT,
+            "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}.get(
+                name, jax.lax.Precision.HIGHEST)
+
 
 def _backend() -> str:
     import jax
@@ -116,7 +133,7 @@ def verify_lowering(shapes=((256, 256, 256), ), kt: int = 4) -> dict:
 
 @functools.lru_cache(maxsize=None)
 def _gemm_chain_call(kt: int, ts_m: int, ts_k: int, ts_n: int, dtype: str,
-                     interpret: bool):
+                     interpret: bool, prec=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -129,7 +146,7 @@ def _gemm_chain_call(kt: int, ts_m: int, ts_k: int, ts_n: int, dtype: str,
         def _():
             out_ref[:] = c_ref[:]
 
-        out_ref[:] += jnp.dot(a_ref[0], b_ref[0],
+        out_ref[:] += jnp.dot(a_ref[0], b_ref[0], precision=prec,
                               preferred_element_type=jnp.float32
                               ).astype(out_ref.dtype)
 
@@ -154,7 +171,8 @@ def gemm_chain(c, a_stack, b_stack):
     kt, ts_m, ts_k = a_stack.shape
     ts_n = b_stack.shape[2]
     try:
-        call = _gemm_chain_call(kt, ts_m, ts_k, ts_n, str(c.dtype), _interpret())
+        call = _gemm_chain_call(kt, ts_m, ts_k, ts_n, str(c.dtype),
+                                _interpret(), dot_precision())
         return call(c, a_stack, b_stack)
     except Exception as e:  # noqa: BLE001
         _fallback("gemm_chain", e)
@@ -163,7 +181,8 @@ def gemm_chain(c, a_stack, b_stack):
 
         def step(acc, ab):
             a, b = ab
-            return acc + jnp.dot(a, b, preferred_element_type=jnp.float32
+            return acc + jnp.dot(a, b, precision=dot_precision(),
+                                 preferred_element_type=jnp.float32
                                  ).astype(acc.dtype), None
 
         out, _ = jax.lax.scan(step, c, (a_stack, b_stack))
@@ -176,7 +195,7 @@ def gemm_chain(c, a_stack, b_stack):
 
 @functools.lru_cache(maxsize=None)
 def _matmul_call(m: int, n: int, k: int, bm: int, bn: int, bk: int,
-                 dtype: str, interpret: bool):
+                 dtype: str, interpret: bool, prec=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -188,7 +207,7 @@ def _matmul_call(m: int, n: int, k: int, bm: int, bn: int, bk: int,
         def _():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        out_ref[:] += jnp.dot(a_ref[:], b_ref[:],
+        out_ref[:] += jnp.dot(a_ref[:], b_ref[:], precision=prec,
                               preferred_element_type=jnp.float32
                               ).astype(out_ref.dtype)
 
@@ -213,12 +232,15 @@ def matmul(a, b, block: Tuple[int, int, int] = (256, 256, 256)):
     n = b.shape[1]
     bm, bn, bk = (min(block[0], m), min(block[1], n), min(block[2], k))
     if m % bm or n % bn or k % bk:
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return jnp.dot(a, b, precision=dot_precision(),
+                       preferred_element_type=jnp.float32).astype(a.dtype)
     try:
-        return _matmul_call(m, n, k, bm, bn, bk, str(a.dtype), _interpret())(a, b)
+        return _matmul_call(m, n, k, bm, bn, bk, str(a.dtype),
+                            _interpret(), dot_precision())(a, b)
     except Exception as e:  # noqa: BLE001
         _fallback("matmul", e)
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return jnp.dot(a, b, precision=dot_precision(),
+                       preferred_element_type=jnp.float32).astype(a.dtype)
 
 
 # ---------------------------------------------------------------------------

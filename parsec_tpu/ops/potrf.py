@@ -26,8 +26,12 @@ from ..dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
 
 def tile_potrf(a):
     """Cholesky of the diagonal tile (lower)."""
+    import jax
     import jax.numpy as jnp
-    return jnp.linalg.cholesky(a)
+    # cholesky's internal dots have no precision arg; scope the default so
+    # f32 factorization keeps f32 accuracy on the MXU
+    with jax.default_matmul_precision("highest"):
+        return jnp.linalg.cholesky(a)
 
 
 def tile_trsm(akk, amk):
@@ -35,19 +39,24 @@ def tile_trsm(akk, amk):
     import jax
     import jax.numpy as jnp
     # solve L X^T = A^T  =>  X = A L^{-T}
-    return jax.scipy.linalg.solve_triangular(akk, amk.T, lower=True).T
+    with jax.default_matmul_precision("highest"):
+        return jax.scipy.linalg.solve_triangular(akk, amk.T, lower=True).T
 
 
 def tile_syrk(amk, amm):
     """A[m,m] <- A[m,m] - A[m,k] · A[m,k]^T."""
     import jax.numpy as jnp
-    return amm - jnp.dot(amk, amk.T, preferred_element_type=jnp.float32).astype(amm.dtype)
+    from .pallas_kernels import dot_precision
+    return amm - jnp.dot(amk, amk.T, precision=dot_precision(),
+                         preferred_element_type=jnp.float32).astype(amm.dtype)
 
 
 def tile_gemm_update(amk, ank, amn):
     """A[m,n] <- A[m,n] - A[m,k] · A[n,k]^T."""
     import jax.numpy as jnp
-    return amn - jnp.dot(amk, ank.T, preferred_element_type=jnp.float32).astype(amn.dtype)
+    from .pallas_kernels import dot_precision
+    return amn - jnp.dot(amk, ank.T, precision=dot_precision(),
+                         preferred_element_type=jnp.float32).astype(amn.dtype)
 
 
 def insert_potrf_tasks(tp: DTDTaskpool, A: TiledMatrix) -> int:
