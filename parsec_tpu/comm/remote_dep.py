@@ -118,6 +118,59 @@ class RemoteDepEngine:
         self._cnt_snaps: Dict[int, Dict[int, Dict[str, Any]]] = {}  # epoch->rank->snap
         self._cnt_epoch = 0
         self._cnt_closed = -1   # highest epoch already merged/abandoned
+        # comm-stream tracing (ref: the comm thread's own profiling stream
+        # with typed activate/put/get events + info dictionary,
+        # remote_dep_mpi.c:1286-1302); bound lazily to ctx.profiling
+        self._pprof = None
+        self._pstream = None
+        self._pkeys: Dict[str, int] = {}
+        self._pev = 0
+
+    # ------------------------------------------------------- comm tracing
+    COMM_EVENTS = ("activate_snd", "activate_rcv", "get_snd", "get_rcv",
+                   "put_snd", "put_rcv")
+    COMM_INFO_DESC = "src{i};dst{i};bytes{q};eager{i}"
+
+    def _comm_prof(self):
+        """The comm machinery's own profiling stream, one per rank
+        (ref: MPI_Activate/MPI_Data_* keywords with src/dst/size info
+        blobs, remote_dep_mpi.c:1286-1302)."""
+        prof = getattr(self.ctx, "profiling", None)
+        if prof is None:
+            return None
+        if self._pstream is None or self._pprof is not prof:
+            self._pprof = prof
+            self._pstream = prof.stream(f"comm(rank {self.ce.my_rank})")
+            self._pkeys = {}
+            for name in self.COMM_EVENTS:
+                start, _ = prof.add_dictionary_keyword(
+                    f"comm::{name}", info_desc=self.COMM_INFO_DESC)
+                self._pkeys[name] = start
+        return self._pstream
+
+    @staticmethod
+    def _payload_nbytes(p) -> int:
+        if p is None:
+            return 0
+        n = getattr(p, "nbytes", None)
+        if n is not None:
+            return int(n)
+        try:
+            return len(p)
+        except TypeError:
+            return 0
+
+    def _trace_comm(self, kind: str, src: int, dst: int, payload,
+                    eager: bool = True) -> None:
+        s = self._comm_prof()
+        if s is None:
+            return
+        from ..utils.trace import EVENT_FLAG_POINT
+        self._pev += 1
+        info = self._pprof.pack_info(f"comm::{kind}", src=src, dst=dst,
+                                     bytes=self._payload_nbytes(payload),
+                                     eager=int(eager))
+        s.trace(self._pkeys[kind], self._pev, 0, EVENT_FLAG_POINT, info)
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -314,6 +367,7 @@ class RemoteDepEngine:
                    "flow": key[4], "dtt": key[5], "forward": subtree,
                    "eager": True, "key": key, "version": 0}
             self.ce.send_am(TAG_REMOTE_DEP_ACTIVATE, child, hdr, payload)
+            self._trace_comm("activate_snd", self.ce.my_rank, child, payload)
             self.fourcounter.message_sent(tp)
 
     # ------------------------------------------------------------ data path
@@ -359,10 +413,14 @@ class RemoteDepEngine:
             if payload.nbytes <= eager_limit:
                 hdr["eager"] = True
                 self.ce.send_am(TAG_REMOTE_DEP_ACTIVATE, child, hdr, payload)
+                self._trace_comm("activate_snd", self.ce.my_rank, child,
+                                 payload)
             else:
                 hdr["eager"] = False
                 hdr["handle"] = self.ce.mem_register(payload)
                 self.ce.send_am(TAG_REMOTE_DEP_ACTIVATE, child, hdr, None)
+                self._trace_comm("activate_snd", self.ce.my_rank, child,
+                                 None, eager=False)
             if tp is not None:
                 self.fourcounter.message_sent(tp)
 
@@ -372,6 +430,8 @@ class RemoteDepEngine:
         tp, parked = self._taskpool_or_park(name, "activate", src, hdr, payload)
         if parked:
             return
+        self._trace_comm("activate_rcv", src, ce.my_rank, payload,
+                         eager=bool(hdr.get("eager", True)))
         if tp is not None:
             self.fourcounter.message_received(tp)
         if hdr.get("ptg"):
@@ -384,11 +444,15 @@ class RemoteDepEngine:
             ce.send_am(TAG_INTERNAL_GET, src,
                        {"handle": hdr["handle"], "requester": ce.my_rank,
                         "origin": hdr}, None)
+            self._trace_comm("get_snd", ce.my_rank, src, None, eager=False)
 
     def _on_get(self, ce, src, hdr, payload) -> None:
+        self._trace_comm("get_rcv", src, ce.my_rank, None, eager=False)
         buf = ce.resolve(hdr["handle"]) if hasattr(ce, "resolve") else None
         ce.send_am(TAG_INTERNAL_PUT, hdr["requester"],
                    {"origin": hdr.get("origin")}, buf)
+        self._trace_comm("put_snd", ce.my_rank, hdr["requester"], buf,
+                         eager=False)
         ce.mem_unregister(hdr["handle"])
 
     def _on_put(self, ce, src, hdr, payload) -> None:
@@ -397,6 +461,7 @@ class RemoteDepEngine:
                                             src, hdr, payload)
         if parked:
             return
+        self._trace_comm("put_rcv", src, ce.my_rank, payload, eager=False)
         self._data_arrived(tp, origin, payload, src)
 
     def _taskpool_or_park(self, name, kind, src, hdr, payload):

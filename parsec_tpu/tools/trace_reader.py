@@ -129,12 +129,85 @@ def to_chrome_trace(trace: TraceData) -> Dict[str, Any]:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+def comm_events(trace: TraceData) -> List[Dict[str, Any]]:
+    """Extract typed comm-stream events (``comm::*`` keywords) with their
+    decoded src/dst/bytes info blobs (ref: the comm-thread stream written
+    by remote_dep_mpi.c:1286-1302)."""
+    by_key = {d["key"]: d for d in trace.dictionary}
+    out: List[Dict[str, Any]] = []
+    for stream in trace.streams:
+        for key, eid, tpid, t, flags, info in stream["events"]:
+            entry = by_key.get(key >> 1)
+            if entry is None or not entry["name"].startswith("comm::"):
+                continue
+            ev = {"kind": entry["name"][len("comm::"):], "t": t,
+                  "stream": stream["name"], "event_id": eid}
+            if entry["fields"] and info:
+                vals = struct.unpack(entry["fmt"], info)
+                ev.update({n: v for (n, _), v in zip(entry["fields"], vals)})
+            out.append(ev)
+    return out
+
+
+def check_comms(paths: List[str]) -> Dict[str, Any]:
+    """Cross-rank validation of the comm streams (the check-comms.py role,
+    ref: tests/profiling/check-comms.py): every send event recorded by one
+    rank must have a matching receive on the destination rank with the
+    same (src, dst, bytes), for each protocol leg (activate/get/put).
+
+    ``paths[i]`` is rank i's PBP file. Returns a summary dict with an
+    ``errors`` list (empty = consistent).
+    """
+    pairs = [("activate_snd", "activate_rcv"), ("get_snd", "get_rcv"),
+             ("put_snd", "put_rcv")]
+    per_rank = [comm_events(read_pbp(p)) for p in paths]
+    errors: List[str] = []
+    counts: Dict[str, int] = {}
+    for snd_kind, rcv_kind in pairs:
+        # multiset of (src, dst, bytes) on each side
+        snd: Dict[Tuple, int] = {}
+        rcv: Dict[Tuple, int] = {}
+        for rank, evs in enumerate(per_rank):
+            for ev in evs:
+                if ev["kind"] == snd_kind:
+                    if ev.get("src") != rank:
+                        errors.append(f"{snd_kind} recorded on rank {rank} "
+                                      f"but src={ev.get('src')}")
+                    k = (ev.get("src"), ev.get("dst"), ev.get("bytes"))
+                    snd[k] = snd.get(k, 0) + 1
+                elif ev["kind"] == rcv_kind:
+                    if ev.get("dst") != rank:
+                        errors.append(f"{rcv_kind} recorded on rank {rank} "
+                                      f"but dst={ev.get('dst')}")
+                    k = (ev.get("src"), ev.get("dst"), ev.get("bytes"))
+                    rcv[k] = rcv.get(k, 0) + 1
+        counts[snd_kind] = sum(snd.values())
+        counts[rcv_kind] = sum(rcv.values())
+        for k, n in snd.items():
+            if rcv.get(k, 0) != n:
+                errors.append(f"{snd_kind} {k} sent {n}x but received "
+                              f"{rcv.get(k, 0)}x")
+        for k, n in rcv.items():
+            if k not in snd:
+                errors.append(f"{rcv_kind} {k} received with no matching send")
+    # protocol shape: every rendezvous put pairs with exactly one get
+    if counts.get("put_snd", 0) != counts.get("get_rcv", 0):
+        errors.append(f"put_snd={counts.get('put_snd')} != "
+                      f"get_rcv={counts.get('get_rcv')}")
+    return {"ranks": len(paths), "counts": counts, "errors": errors}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
-        print("usage: trace_reader <trace.pbp> [--ctf out.json] [--csv out.csv]",
+        print("usage: trace_reader <trace.pbp> [--ctf out.json] [--csv out.csv]\n"
+              "       trace_reader --check-comms <rank0.pbp> <rank1.pbp> ...",
               file=sys.stderr)
         return 2
+    if argv[0] == "--check-comms":
+        summary = check_comms(argv[1:])
+        print(json.dumps(summary))
+        return 1 if summary["errors"] else 0
     trace = read_pbp(argv[0])
     print(f"trace: {len(trace.dictionary)} keywords, "
           f"{len(trace.streams)} streams, "

@@ -147,3 +147,69 @@ END
     # chain: X flows through scratch tiles; final write-back is a PTG-only
     # complete-execution step, so check the last scratch value instead
     assert dtp.executed >= NT
+
+
+# ----------------------------------------------------- comm-stream tracing
+
+def test_comm_trace_2rank_check_comms(tmp_path):
+    """Distributed run with per-rank tracers: the comm machinery writes
+    typed activate/get/put events with src/dst/bytes to its own stream, and
+    the cross-rank validator proves wire symmetry (the check-comms.py role,
+    ref: remote_dep_mpi.c:1286-1302, tests/profiling/check-comms.py)."""
+    import numpy as np
+
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.ops.gemm import insert_gemm_tasks
+    from parsec_tpu.tools.trace_reader import check_comms, comm_events, read_pbp
+    from parsec_tpu.utils import mca
+
+    N, TS = 64, 16
+    rng = np.random.default_rng(4)
+    a = rng.standard_normal((N, N)).astype(np.float32)
+    b = rng.standard_normal((N, N)).astype(np.float32)
+    # small eager limit so large tiles exercise the rendezvous (get/put) leg
+    mca.set("comm_eager_limit", 512)
+    try:
+        def program(rank, fabric):
+            ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+            ctx.profiling = Profiling()
+            RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+            kw = dict(nodes=2, myrank=rank, P=2, Q=1)
+            A = TwoDimBlockCyclic("ctA", N, N, TS, TS, **kw)
+            B = TwoDimBlockCyclic("ctB", N, N, TS, TS, **kw)
+            C = TwoDimBlockCyclic("ctC", N, N, TS, TS, **kw)
+            A.fill(lambda m, n: a[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
+            B.fill(lambda m, n: b[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
+            C.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+            tp = DTDTaskpool(ctx, "commtrace")
+            insert_gemm_tasks(tp, A, B, C)
+            tp.wait(timeout=60)
+            tp.close()
+            ctx.wait(timeout=30)
+            ctx.fini()
+            path = str(tmp_path / f"rank{rank}.pbp")
+            ctx.profiling.dump(path)
+            return path
+
+        paths = run_distributed(2, program, timeout=120)
+    finally:
+        mca.params.unset("comm_eager_limit")
+
+    evs0 = comm_events(read_pbp(paths[0]))
+    assert evs0, "rank 0 recorded no comm events"
+    kinds = {e["kind"] for e in evs0}
+    assert "activate_snd" in kinds and "activate_rcv" in kinds
+    # 16x16 f32 tiles (1KiB) exceed the 512B eager limit -> rendezvous legs
+    assert "put_rcv" in kinds or "put_snd" in kinds, kinds
+    summary = check_comms(paths)
+    assert summary["errors"] == [], summary
+    assert summary["counts"]["activate_snd"] > 0
+    assert summary["counts"]["put_snd"] > 0          # rendezvous exercised
+    assert summary["counts"]["activate_snd"] == summary["counts"]["activate_rcv"]
+
+    # the CLI entry point (the reference's standalone checker script)
+    from parsec_tpu.tools import trace_reader
+    assert trace_reader.main(["--check-comms", *paths]) == 0
