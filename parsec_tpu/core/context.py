@@ -243,16 +243,22 @@ class Context:
                             timeout=timeout)
         return tp.completed
 
-    def fini(self) -> None:
+    def fini(self, timeout: Optional[float] = None) -> None:
         """parsec_fini: drain and join workers; report statistics
         (the per-thread usage + device statistics reports the reference
         prints at shutdown, scheduling.c:47-90 / device.c). After a body
         error the context is poisoned: fini skips the drain and tears down
-        cleanly instead of re-raising."""
+        cleanly instead of re-raising. With ``timeout``, a drain that cannot
+        finish (e.g. a peer rank died mid-graph) degrades to a warned
+        teardown instead of hanging forever."""
         if self._finalized:
             return
         if self._error is None:
-            self.wait()
+            try:
+                self.wait(timeout=timeout)
+            except TimeoutError:
+                output.warning("fini: drain timed out with work outstanding; "
+                               "tearing down anyway")
         self._finalized = True
         for s in self.streams:
             if s.nb_executed:
@@ -577,11 +583,25 @@ class Context:
             # destination set (pre-send remote reshape, parsec/remote_dep.h:117;
             # remote_multiple_outs_same_pred_flow.jdf)
             remote_by_dtt: Dict[Optional[str], set] = {}
+            null_checked = False
             for dep in flow.deps_out:
                 if dep.cond is not None and not dep.cond(task.locals):
                     continue
                 if dep.task_class is None:
                     continue  # write-back to memory handled by the body/copy model
+                if not null_checked and not (flow.access & FLOW_ACCESS_CTL):
+                    # forwarding no-data on a data flow is a program bug the
+                    # runtime must catch at the source (ref: "A NULL is
+                    # forwarded", parsec.c:1879; ptgpp forward_*_NULL tests)
+                    null_checked = True
+                    slot = task.data[flow.flow_index]
+                    out = slot.data_out if slot.data_out is not None \
+                        else slot.data_in
+                    if (out.payload if hasattr(out, "payload") else out) is None:
+                        output.fatal(
+                            f"A NULL is forwarded\n"
+                            f"\tfrom: {tc.name}{task.key} flow {flow.name}\n"
+                            f"\tto:   {dep.task_class.name}")
                 targets = dep.target_locals(task.locals) if dep.target_locals else [task.locals]
                 if isinstance(targets, dict):
                     targets = [targets]

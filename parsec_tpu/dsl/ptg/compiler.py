@@ -132,10 +132,26 @@ class PTGTaskpool(Taskpool):
             "min": min, "max": max, "abs": abs, "range": range, "len": len,
             "int": int, "divmod": divmod,
         })
+        prologue_names: Dict[str, Any] = {}
+        if program.spec.prologue:
+            # the '%{...%}' host-language escape (jdf2c.c:54): full Python,
+            # executed once per instantiation; its definitions become
+            # program globals visible to ranges, guards, and bodies
+            pns: Dict[str, Any] = {"np": np}
+            try:
+                exec(compile(program.spec.prologue,  # noqa: S102
+                             f"<ptg-prologue:{program.spec.name}>", "exec"),
+                     pns)
+            except Exception as e:
+                output.fatal(f"PTG taskpool {self.name}: prologue failed: {e}")
+            prologue_names = {k: v for k, v in pns.items()
+                              if not k.startswith("__") and k != "np"}
+            self.env_base.update(prologue_names)
         self.env_base.update(globals_)
         self.collections = collections
         missing = [g for g in program.spec.globals
-                   if g not in globals_ and g not in collections]
+                   if g not in globals_ and g not in collections
+                   and g not in prologue_names]
         if missing:
             output.fatal(f"PTG taskpool {self.name}: missing globals {missing}")
         #: (tc_name, pkey, flow_index) -> payload shipped from a remote

@@ -128,6 +128,9 @@ class ProgramSpec:
     globals: List[str] = field(default_factory=list)
     task_classes: List[TaskClassSpec] = field(default_factory=list)
     name: str = "ptg"
+    #: host-language prologue executed into program globals at instantiate
+    #: time (the JDF inline-C escape 'extern "C" %{...%}', jdf2c.c:54)
+    prologue: str = ""
 
     def task_class(self, name: str) -> Optional[TaskClassSpec]:
         for tc in self.task_classes:
@@ -253,6 +256,20 @@ def _parse_dep(direction: str, text: str, line_no: int, line: str) -> DepSpec:
             dep.endpoint = _parse_endpoint(rest, line_no, line)
     else:
         dep.endpoint = _parse_endpoint(text, line_no, line)
+    if direction == "out":
+        # NEW/NULL are input-only, in ANY branch of a guarded dep (ref:
+        # ptgpp errors, tests/dsl/ptg/ptgpp/output_{NULL,NEW}[_true,_false])
+        for ep in (dep.endpoint, dep.else_endpoint):
+            if ep is None:
+                continue
+            if ep.kind == "null":
+                raise PTGSyntaxError(
+                    "NULL data only supported in IN dependencies",
+                    line_no, line)
+            if ep.kind == "new":
+                raise PTGSyntaxError(
+                    "Automatic data allocation with NEW only supported "
+                    "in IN dependencies", line_no, line)
     return dep
 
 
@@ -283,6 +300,21 @@ def parse(source: str, name: str = "ptg") -> ProgramSpec:
         raw = lines[i]
         line = _strip_comment(raw).strip()
         if not line:
+            i += 1
+            continue
+        if line in ("%{", "%prologue"):
+            # '%{ ... %}' / '%prologue ... %}': host-language helper block,
+            # executed into program globals when the taskpool instantiates
+            # (the reference JDF's inline-C prologue, jdf2c.c:54) — a .jdf-
+            # style file can carry its own helper functions and constants
+            block: List[str] = []
+            i += 1
+            while i < len(lines) and lines[i].strip() != "%}":
+                block.append(lines[i])
+                i += 1
+            if i >= len(lines):
+                raise err("unterminated %{ prologue block (missing %})")
+            prog.prologue += "\n".join(block) + "\n"
             i += 1
             continue
         m = _RE_GLOBAL.match(line)
@@ -415,7 +447,11 @@ def _validate(prog: ProgramSpec) -> None:
             raise PTGSyntaxError(
                 f"task class {tc.name}: parameters {missing} have no range")
         for f in tc.flows:
-            if f.access != FLOW_CTL and not any(d.direction == "in" for d in f.deps):
+            # WRITE-only flows are scratch outputs (ref: write_check.jdf's
+            # "WRITE A1 -> ..." — allocated at run time, body fills them);
+            # READ/RW flows must name where their data comes from
+            if f.access not in (FLOW_CTL, FLOW_WRITE) and \
+                    not any(d.direction == "in" for d in f.deps):
                 raise PTGSyntaxError(
                     f"task class {tc.name}: data flow {f.name!r} has no input dep")
             for d in f.deps:

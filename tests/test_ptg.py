@@ -381,6 +381,69 @@ END
     "no_task_classes": """
 %global A
 """,
+    # NULL / NEW are input-only (ref: ptgpp output_NULL*, output_NEW* —
+    # "NULL data only supported in IN dependencies." / "Automatic data
+    # allocation with NEW only supported in IN dependencies.")
+    "output_NULL": """
+%global A
+T(k)
+  k = 0 .. 3
+  RW X <- A(k)
+       -> NULL
+BODY
+  X = X
+END
+""",
+    "output_NULL_true": """
+%global A
+T(k)
+  k = 0 .. 10
+  RW X <- A(k)
+       -> (k < 5) ? NULL : A(k)
+BODY
+  X = X
+END
+""",
+    "output_NULL_false": """
+%global A
+T(k)
+  k = 0 .. 10
+  RW X <- A(k)
+       -> (k < 5) ? A(k) : NULL
+BODY
+  X = X
+END
+""",
+    "output_NEW": """
+%global A
+T(k)
+  k = 0 .. 3
+  RW X <- A(k)
+       -> NEW
+BODY
+  X = X
+END
+""",
+    "output_NEW_true": """
+%global A
+T(k)
+  k = 0 .. 10
+  RW X <- A(k)
+       -> (k < 5) ? NEW : A(k)
+BODY
+  X = X
+END
+""",
+    "output_NEW_false": """
+%global A
+T(k)
+  k = 0 .. 10
+  RW X <- A(k)
+       -> (k < 5) ? A(k) : NEW
+BODY
+  X = X
+END
+""",
 }
 
 
@@ -417,3 +480,216 @@ END
     ctx.wait()
     assert tp.completed
     assert np.allclose(A.to_dense(), 4.0)   # k = 3,2,1,0 all ran
+
+
+# ---------------------------------------------------------------------------
+# NULL forwarding, write_check, %prologue (ref: tests/dsl/ptg/ptgpp)
+# ---------------------------------------------------------------------------
+
+FORWARD_NULL_SRC = """
+%global A
+%global NB
+Task(k)
+  k = 0 .. NB
+  : A(k, 0)
+  {ACCESS} X <- (k == 0) ? NULL : X Task(k-1)
+       -> (k < NB) ? X Task(k+1)
+BODY
+  pass
+END
+"""
+
+
+@pytest.mark.parametrize("access", ["RW", "READ"])
+def test_forward_null_fatals(ctx, access):
+    """Forwarding a NULL on a data flow aborts with attribution at the
+    source (ref: parsec.c:1879 'A NULL is forwarded';
+    ptgpp forward_RW_NULL / forward_READ_NULL)."""
+    NB = 3
+    A = TiledMatrix("Afn" + access, 16, 4, 4, 4)
+    A.fill(lambda m, n: np.ones((4, 4), np.float32))
+    prog = compile_ptg(FORWARD_NULL_SRC.replace("{ACCESS}", access),
+                       "fwdnull" + access)
+    tp = prog.instantiate(ctx, globals={"NB": NB}, collections={"A": A})
+    with pytest.raises(RuntimeError, match="A NULL is forwarded"):
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+
+
+def test_forward_null_fatals_2rank():
+    """The same NULL-forward abort fires on the source rank of a
+    distributed chain (ref: forward_RW_NULL:mp)."""
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    NB = 3
+
+    def program(rank, fabric):
+        ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        A = TwoDimBlockCyclic("Afn2", 16, 4, 4, 4, P=2, Q=1,
+                              nodes=2, myrank=rank)
+        A.fill(lambda m, n: np.ones((4, 4), np.float32))
+        prog = compile_ptg(FORWARD_NULL_SRC.replace("{ACCESS}", "RW"),
+                           "fwdnull2")
+        tp = prog.instantiate(ctx, globals={"NB": NB}, collections={"A": A})
+        try:
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=10)
+            return "completed"
+        except Exception as e:  # noqa: BLE001 - the fatal (rank 0) or the
+            # starvation timeout it causes downstream (rank 1)
+            return f"{type(e).__name__}: {e}"
+        finally:
+            try:
+                ctx.fini(timeout=5)
+            except Exception:
+                pass
+
+    results = run_distributed(2, program, timeout=60)
+    # rank 0 owns Task(0) (the NULL source): the fatal fires there
+    assert "A NULL is forwarded" in results[0]
+
+
+WRITE_CHECK_SRC = """
+%global A
+%global NT
+%global BLOCK
+
+STARTUP(k)
+  k = 0 .. NT
+  : A(0, k)
+  WRITE A1 -> A2 TASK1(k)
+BODY
+  A1 = (np.arange(BLOCK * BLOCK, dtype=np.float32) + k * BLOCK).reshape(BLOCK, BLOCK)
+END
+
+TASK1(k)
+  k = 0 .. NT
+  : A(0, k)
+  WRITE A3 -> A1 TASK2(k)
+  RW    A1 <- A(0, k)
+           -> A2 TASK2(k)
+  READ  A2 <- A1 STARTUP(k)
+BODY
+  A1 = A1 + 1.0
+  A3 = A2
+END
+
+TASK2(k)
+  k = 0 .. NT
+  : A(0, k)
+  READ A1 <- A3 TASK1(k)
+  RW   A2 <- A1 TASK1(k)
+          -> A(0, k)
+BODY
+  A2 = A2 + A1
+END
+"""
+
+
+def _write_check_run(ctx, A, NT, BLOCK):
+    prog = compile_ptg(WRITE_CHECK_SRC, "write_check")
+    tp = prog.instantiate(ctx, globals={"NT": NT, "BLOCK": BLOCK},
+                          collections={"A": A})
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=60)
+    return tp
+
+
+def test_write_check(ctx):
+    """WRITE-only scratch flows forwarded through a 3-task pipeline: the
+    final tile content proves every write propagated (ref: write_check.jdf
+    — WRITE A1/A3 relay chains, RW chains, memory write-back)."""
+    NT, BLOCK = 3, 4
+    A = TiledMatrix("Awc", BLOCK, (NT + 1) * BLOCK, BLOCK, BLOCK)
+    A.fill(lambda m, n: np.ones((BLOCK, BLOCK), np.float32))
+    tp = _write_check_run(ctx, A, NT, BLOCK)
+    assert tp.completed
+    for k in range(NT + 1):
+        # A(0,k) = (ones + 1) + startup_index = 2 + k*BLOCK + arange
+        expect = (np.arange(BLOCK * BLOCK, dtype=np.float32) + k * BLOCK
+                  ).reshape(BLOCK, BLOCK) + 2.0
+        got = np.asarray(A.data_of(0, k).newest_copy().payload)
+        np.testing.assert_allclose(got, expect)
+
+
+def test_write_check_2rank():
+    """write_check across 2 ranks (ref: write_check:mp): the WRITE relay
+    and RW chains cross the wire via the remote-dep protocol."""
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+
+    NT, BLOCK = 3, 4
+
+    def program(rank, fabric):
+        ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        A = TwoDimBlockCyclic("Awc2", BLOCK, (NT + 1) * BLOCK, BLOCK, BLOCK,
+                              P=1, Q=2, nodes=2, myrank=rank)
+        A.fill(lambda m, n: np.ones((BLOCK, BLOCK), np.float32))
+        _write_check_run(ctx, A, NT, BLOCK)
+        out = {}
+        for k in range(NT + 1):
+            if A.rank_of(0, k) == rank:
+                out[k] = np.asarray(A.data_of(0, k).newest_copy().payload)
+        ctx.fini()
+        return out
+
+    results = run_distributed(2, program, timeout=90)
+    seen = {}
+    for out in results:
+        seen.update(out)
+    assert len(seen) == NT + 1
+    for k, got in seen.items():
+        expect = (np.arange(BLOCK * BLOCK, dtype=np.float32) + k * BLOCK
+                  ).reshape(BLOCK, BLOCK) + 2.0
+        np.testing.assert_allclose(got, expect)
+
+
+PROLOGUE_SRC = """
+%{
+import math
+NT = 7
+def weight(k):
+    return (k + 1) ** 0.5     # tracer-safe: bodies are jitted
+def last(nt):
+    return nt - int(math.copysign(1, nt))   # host-side helpers may use math
+%}
+%global A
+
+T(k)
+  k = 0 .. last(NT)
+  : A(0, k)
+  RW X <- A(0, k)
+       -> A(0, k)
+BODY
+  X = X + weight(k)
+END
+"""
+
+
+def test_prologue_block(ctx):
+    """A %{...%} prologue carries helpers + constants the ranges and bodies
+    use — the file is self-contained like a JDF with an inline-C prologue
+    (ref: extern "C" %{...%} escapes, jdf2c.c:54)."""
+    prog = compile_ptg(PROLOGUE_SRC, "prologue")
+    assert "def weight" in prog.spec.prologue
+    A = TiledMatrix("Apl", 4, 7 * 4, 4, 4)
+    A.fill(lambda m, n: np.zeros((4, 4), np.float32))
+    # no globals= needed: NT, weight, last all come from the prologue
+    tp = prog.instantiate(ctx, globals={}, collections={"A": A})
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=30)
+    assert tp.completed
+    for k in range(7):
+        np.testing.assert_allclose(
+            np.asarray(A.data_of(0, k).newest_copy().payload),
+            np.sqrt(k + 1), rtol=1e-6)
+
+
+def test_prologue_unterminated_rejected():
+    with pytest.raises(P.PTGSyntaxError, match="unterminated"):
+        P.parse("%{\nx = 1\n")
