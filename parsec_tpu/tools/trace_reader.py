@@ -129,6 +129,26 @@ def to_chrome_trace(trace: TraceData) -> Dict[str, Any]:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+def read_otf2(path: str) -> TraceData:
+    """Read a PTF2 archive (the OTF2-class backend) into the same model as
+    PBP files, so the whole analysis pipeline is format-agnostic."""
+    from ..utils.trace_otf2 import read_archive
+    d = read_archive(path)
+    dictionary = []
+    for e in d["dictionary"]:
+        fields, fmt = parse_info_desc(e["info_desc"])
+        dictionary.append({**e, "fields": fields, "fmt": fmt})
+    return TraceData(d["t0"], dictionary, d["streams"])
+
+
+def read_trace(path: str) -> TraceData:
+    """Format dispatch: PTF2 archives are directories, PBP traces files."""
+    import os
+    if os.path.isdir(path):
+        return read_otf2(path)
+    return read_pbp(path)
+
+
 def comm_events(trace: TraceData) -> List[Dict[str, Any]]:
     """Extract typed comm-stream events (``comm::*`` keywords) with their
     decoded src/dst/bytes info blobs (ref: the comm-thread stream written
@@ -160,7 +180,7 @@ def check_comms(paths: List[str]) -> Dict[str, Any]:
     """
     pairs = [("activate_snd", "activate_rcv"), ("get_snd", "get_rcv"),
              ("put_snd", "put_rcv")]
-    per_rank = [comm_events(read_pbp(p)) for p in paths]
+    per_rank = [comm_events(read_trace(p)) for p in paths]
     errors: List[str] = []
     counts: Dict[str, int] = {}
     for snd_kind, rcv_kind in pairs:
@@ -208,7 +228,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         summary = check_comms(argv[1:])
         print(json.dumps(summary))
         return 1 if summary["errors"] else 0
-    trace = read_pbp(argv[0])
+    trace = read_trace(argv[0])
     print(f"trace: {len(trace.dictionary)} keywords, "
           f"{len(trace.streams)} streams, "
           f"{sum(len(s['events']) for s in trace.streams)} events")

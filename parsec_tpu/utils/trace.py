@@ -32,6 +32,10 @@ from . import mca, output
 
 mca.register("profile_enabled", False, "Record runtime events", type=bool)
 mca.register("profile_filename", "parsec_tpu.pbp", "Trace output path")
+mca.register("profile_backend", "pbp",
+             "Trace output format: 'pbp' (flat binary file) or 'otf2' "
+             "(PTF2 archive directory: anchor + global defs + per-location "
+             "event files, the profiling_otf2.c role)", type=str)
 
 MAGIC = b"PTPBP001"
 
@@ -131,9 +135,18 @@ class Profiling:
         return struct.pack(e.fmt, *[kw.get(n, 0) for n, _ in e.fields])
 
     # -- output ------------------------------------------------------------------
-    def dump(self, path: Optional[str] = None) -> str:
-        """Write the PBP file (ref: dbp file writing at parsec_fini)."""
+    def dump(self, path: Optional[str] = None,
+             backend: Optional[str] = None) -> str:
+        """Write the trace (ref: dbp file writing at parsec_fini). The
+        backend — flat PBP file or OTF2-class PTF2 archive — is chosen by
+        ``backend`` / ``--mca profile_backend`` (profiling_otf2.c role)."""
         path = path or mca.get("profile_filename", "parsec_tpu.pbp")
+        backend = backend or mca.get("profile_backend", "pbp")
+        if backend == "otf2":
+            from .trace_otf2 import write_archive
+            return write_archive(self, path)
+        if backend != "pbp":
+            raise ValueError(f"unknown profile_backend {backend!r}")
         with self._lock:
             buf = io.BytesIO()
             buf.write(MAGIC)

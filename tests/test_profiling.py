@@ -213,3 +213,97 @@ def test_comm_trace_2rank_check_comms(tmp_path):
     # the CLI entry point (the reference's standalone checker script)
     from parsec_tpu.tools import trace_reader
     assert trace_reader.main(["--check-comms", *paths]) == 0
+
+
+# ------------------------------------------------- OTF2-class backend
+
+def test_otf2_archive_roundtrip(ctx, tmp_path):
+    """The second trace backend (profiling_otf2.c role): same tracer state
+    written as a PTF2 archive (anchor + global defs + per-location event
+    files, varint/delta encoded) reads back IDENTICAL to the PBP file
+    through the shared analysis pipeline."""
+    import os
+
+    from parsec_tpu.tools.trace_reader import (read_pbp, read_trace,
+                                               to_chrome_trace, to_dataframe)
+
+    prof = Profiling()
+    TaskProfiler(prof).enable(ctx)
+    _run_chain(ctx, 8)
+
+    pbp = prof.dump(str(tmp_path / "t.pbp"))
+    arch = prof.dump(str(tmp_path / "t"), backend="otf2")
+    assert os.path.isdir(arch) and arch.endswith(".ptf2")
+    assert os.path.exists(os.path.join(arch, "anchor.json"))
+    assert os.path.exists(os.path.join(arch, "global.defs"))
+    assert any(f.startswith("loc_") for f in os.listdir(arch))
+
+    a = read_pbp(pbp)
+    b = read_trace(arch)
+    assert [d["name"] for d in a.dictionary] == [d["name"] for d in b.dictionary]
+    assert [s["name"] for s in a.streams] == [s["name"] for s in b.streams]
+    dfa, dfb = to_dataframe(a), to_dataframe(b)
+    assert len(dfa) == len(dfb) == 8
+    # timestamps survive the ns-tick delta encoding to <1us
+    assert (abs(dfa["duration"] - dfb["duration"]) < 1e-6).all()
+    assert list(dfa["name"]) == list(dfb["name"])
+    ctf = to_chrome_trace(b)
+    assert len([e for e in ctf["traceEvents"] if e["ph"] == "X"]) == 8
+
+
+def test_otf2_backend_via_mca(ctx, tmp_path):
+    """--mca profile_backend otf2 flips the default dump format."""
+    from parsec_tpu.utils import mca
+
+    prof = Profiling()
+    TaskProfiler(prof).enable(ctx)
+    _run_chain(ctx, 4)
+    mca.set("profile_backend", "otf2")
+    try:
+        out = prof.dump(str(tmp_path / "m"))
+    finally:
+        mca.params.unset("profile_backend")
+    import os
+    assert os.path.isdir(out)
+    with pytest.raises(ValueError):
+        prof.dump(str(tmp_path / "x"), backend="hdf5")
+
+
+def test_check_comms_reads_otf2_archives(tmp_path):
+    """check-comms is format-agnostic: rank traces written as PTF2 archives
+    validate the same as PBP files."""
+    import numpy as np
+
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.ops.gemm import insert_gemm_tasks
+    from parsec_tpu.tools.trace_reader import check_comms
+
+    N, TS = 32, 16
+
+    def program(rank, fabric):
+        ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        ctx.profiling = Profiling()
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        kw = dict(nodes=2, myrank=rank, P=2, Q=1)
+        A = TwoDimBlockCyclic("o2A", N, N, TS, TS, **kw)
+        B = TwoDimBlockCyclic("o2B", N, N, TS, TS, **kw)
+        C = TwoDimBlockCyclic("o2C", N, N, TS, TS, **kw)
+        rng = np.random.default_rng(1)
+        A.fill(lambda m, n: rng.standard_normal((TS, TS)).astype(np.float32))
+        B.fill(lambda m, n: rng.standard_normal((TS, TS)).astype(np.float32))
+        C.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        tp = DTDTaskpool(ctx, "otf2comm")
+        insert_gemm_tasks(tp, A, B, C)
+        tp.wait(timeout=60)
+        tp.close()
+        ctx.wait(timeout=30)
+        ctx.fini()
+        return ctx.profiling.dump(str(tmp_path / f"r{rank}"), backend="otf2")
+
+    paths = run_distributed(2, program, timeout=120)
+    summary = check_comms(paths)
+    assert summary["errors"] == [], summary
+    assert summary["counts"]["activate_snd"] > 0
