@@ -39,6 +39,10 @@ TAG_DTD_AUDIT = 11        # DTD replay-consistency auditor exchange
 CAP_ONESIDED = 0x1
 CAP_MULTITHREADED = 0x2
 CAP_ACCELERATOR_MEM = 0x4   # can move device-resident buffers directly
+CAP_STREAMING = 0x8         # AM payloads ride the same ordered stream as
+                            # headers: rendezvous buys no registration or
+                            # one-sidedness, so eager (PUT-with-activate)
+                            # is the right default at ANY size
 
 
 @dataclass

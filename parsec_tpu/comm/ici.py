@@ -51,7 +51,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from ..utils.counters import counters
-from .engine import CAP_ACCELERATOR_MEM, CAP_MULTITHREADED
+from .engine import CAP_ACCELERATOR_MEM, CAP_MULTITHREADED, CAP_STREAMING
 from .threads import ThreadFabric, ThreadsCE
 
 CTR_D2D_MSGS = "comm.ici_d2d_msgs"
@@ -74,7 +74,7 @@ class ICICE(ThreadsCE):
     the fabric, so it arrives HBM-resident on the consumer.
     """
 
-    capabilities = CAP_MULTITHREADED | CAP_ACCELERATOR_MEM
+    capabilities = CAP_MULTITHREADED | CAP_ACCELERATOR_MEM | CAP_STREAMING
 
     def __init__(self, fabric: ThreadFabric, my_rank: int,
                  device_map: Sequence) -> None:

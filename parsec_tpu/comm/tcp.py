@@ -42,7 +42,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils import output
-from .engine import CommEngine, CAP_MULTITHREADED
+from .engine import CommEngine, CAP_MULTITHREADED, CAP_STREAMING
 
 _LEN = struct.Struct("!I")
 
@@ -132,7 +132,7 @@ def _recv_frame(sock: socket.socket):
 class TCPCE(CommEngine):
     """CE backend over a full TCP mesh between processes."""
 
-    capabilities = CAP_MULTITHREADED
+    capabilities = CAP_MULTITHREADED | CAP_STREAMING
 
     def __init__(self, my_rank: int, nb_ranks: int,
                  rendezvous: Tuple[str, int], timeout: float = 60.0) -> None:

@@ -21,7 +21,7 @@ import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
-from .engine import CommEngine, CAP_MULTITHREADED
+from .engine import CommEngine, CAP_MULTITHREADED, CAP_STREAMING
 
 
 class ThreadFabric:
@@ -75,7 +75,7 @@ def run_distributed(nb_ranks: int, program: Callable[[int, ThreadFabric], Any],
 class ThreadsCE(CommEngine):
     """CE backend over the thread fabric."""
 
-    capabilities = CAP_MULTITHREADED
+    capabilities = CAP_MULTITHREADED | CAP_STREAMING
 
     def __init__(self, fabric: ThreadFabric, my_rank: int) -> None:
         super().__init__(my_rank, fabric.nb_ranks)

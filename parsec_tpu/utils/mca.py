@@ -117,6 +117,16 @@ class ParamRegistry:
         p = self._require(name)
         p.has_explicit = False
 
+    def is_default(self, name: str) -> bool:
+        """True when no layer (set()/cmdline/env/paramfile) overrides the
+        registered default — lets components pick transport-aware defaults
+        while user choices always win."""
+        p = self._params.get(name)
+        if p is None:
+            return True
+        return not (p.has_explicit or p.has_cmdline or p.has_filevalue
+                    or os.environ.get(_ENV_PREFIX + name) is not None)
+
     def on_change(self, name: str, cb: Callable[[Any], None]) -> None:
         self._require(name).on_change.append(cb)
 
@@ -180,4 +190,5 @@ register = params.register
 get = params.get
 set = params.set
 unset = params.unset
+is_default = params.is_default
 parse_cmdline = params.parse_cmdline
