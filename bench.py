@@ -172,11 +172,35 @@ def main() -> None:
     d_lo, d_hi = 1, 3
     t_lo = min(run_dags(d_lo) for _ in range(reps))
     t_hi = min(run_dags(d_hi) for _ in range(reps))
-    best_s = max((t_hi - t_lo) / (d_hi - d_lo), 1e-9)
-    gflops = gemm_flops(N, N, N) / 1e9 / best_s
-    log(f"DTD tiled GEMM N={N} TS={TS} (slope {d_lo}->{d_hi} DAGs): "
-        f"{best_s*1e3:.2f} ms -> {gflops:.1f} GFLOP/s "
+    sched_s = max((t_hi - t_lo) / (d_hi - d_lo), 1e-9)
+    sched_gflops = gemm_flops(N, N, N) / 1e9 / sched_s
+    log(f"DTD tiled GEMM N={N} TS={TS} (scheduler, slope {d_lo}->{d_hi} "
+        f"DAGs): {sched_s*1e3:.2f} ms -> {sched_gflops:.1f} GFLOP/s "
         f"(T1 {t_lo*1e3:.1f} ms, T3 {t_hi*1e3:.1f} ms)")
+
+    # ---- graph-capture mode: the whole DAG as ONE XLA executable ----------
+    # (dsl/capture.py) — the framework's recommended single-chip mode for
+    # static DAGs: dispatch cost amortized to one, cross-task fusion
+    def run_captured(n_dags: int) -> float:
+        tp = DTDTaskpool(ctx, "gemm-cap", capture=True)
+        t0 = time.perf_counter()
+        for _ in range(n_dags):
+            insert_gemm_tasks(tp, A, B, C, batch_k=True)
+            tp.wait()
+        tp.close()
+        s = fuse_all([jnp.asarray(C.data_of(m, n).newest_copy().payload)
+                      for m in range(mt) for n in range(mt)])
+        np.asarray(jax.device_get(s))
+        return time.perf_counter() - t0
+
+    run_captured(1)      # compile the captured program
+    ct_lo = min(run_captured(d_lo) for _ in range(reps))
+    ct_hi = min(run_captured(d_hi) for _ in range(reps))
+    cap_s = max((ct_hi - ct_lo) / (d_hi - d_lo), 1e-9)
+    cap_gflops = gemm_flops(N, N, N) / 1e9 / cap_s
+    log(f"captured tiled GEMM N={N} TS={TS}: {cap_s*1e3:.2f} ms -> "
+        f"{cap_gflops:.1f} GFLOP/s")
+    gflops = max(sched_gflops, cap_gflops)   # the framework's best mode
 
     # small-size correctness gate (separate matrices, same code path)
     def mk_small(dcname, src):
@@ -247,11 +271,32 @@ def main() -> None:
     run_potrf(1)   # warm
     pt_lo = min(run_potrf(1) for _ in range(reps))
     pt_hi = min(run_potrf(3) for _ in range(reps))
-    potrf_s = max((pt_hi - pt_lo) / 2, 1e-9)
-    potrf_gflops = potrf_flops / 1e9 / potrf_s
-    log(f"DTD tiled POTRF N={pN} TS={pTS} (slope): {potrf_s*1e3:.2f} ms -> "
-        f"{potrf_gflops:.1f} GFLOP/s (raw XLA cholesky: "
-        f"{raw_potrf_gflops:.1f})")
+    potrf_sched_s = max((pt_hi - pt_lo) / 2, 1e-9)
+    potrf_sched_gflops = potrf_flops / 1e9 / potrf_sched_s
+
+    def run_potrf_captured(n_dags: int) -> float:
+        Pm.fill(lambda m, k: spd[m*pTS:(m+1)*pTS, k*pTS:(k+1)*pTS])
+        tp = DTDTaskpool(ctx, "potrf-cap", capture=True)
+        t0 = time.perf_counter()
+        for _ in range(n_dags):
+            insert_potrf_tasks(tp, Pm)
+            tp.wait()
+        tp.close()
+        s = fuse_tril([jnp.asarray(Pm.data_of(m, k).newest_copy().payload)
+                       for m in range(pmt) for k in range(m + 1)])
+        np.asarray(jax.device_get(s))
+        return time.perf_counter() - t0
+
+    run_potrf_captured(1)
+    cpt_lo = min(run_potrf_captured(1) for _ in range(reps))
+    cpt_hi = min(run_potrf_captured(3) for _ in range(reps))
+    potrf_cap_s = max((cpt_hi - cpt_lo) / 2, 1e-9)
+    potrf_cap_gflops = potrf_flops / 1e9 / potrf_cap_s
+    potrf_gflops = max(potrf_sched_gflops, potrf_cap_gflops)
+    log(f"DTD tiled POTRF N={pN} TS={pTS} (slope): scheduler "
+        f"{potrf_sched_s*1e3:.2f} ms -> {potrf_sched_gflops:.1f} GFLOP/s, "
+        f"captured {potrf_cap_s*1e3:.2f} ms -> {potrf_cap_gflops:.1f} "
+        f"GFLOP/s (raw XLA cholesky: {raw_potrf_gflops:.1f})")
 
     # small-size correctness gate for the same POTRF code path
     spd_s = make_spd(256, seed=11)
@@ -346,8 +391,12 @@ def main() -> None:
         "timing": "slope+forced-barrier",
         "dispatch_ms": round(dispatch_ms, 3),
         "vs_baseline": round(gflops / raw_gflops, 4),
+        "gemm_sched_gflops": round(sched_gflops, 1),
+        "gemm_captured_gflops": round(cap_gflops, 1),
         "potrf_gflops": round(potrf_gflops, 1),
         "potrf_vs_baseline": round(potrf_gflops / raw_potrf_gflops, 4),
+        "potrf_sched_gflops": round(potrf_sched_gflops, 1),
+        "potrf_captured_gflops": round(potrf_cap_gflops, 1),
         "tasks_per_sec": round(tasks_per_sec),
         "dtd_insert_tasks_per_sec": round(dtd_rate),
         "tasks_per_sec_by_cores": {str(k): v for k, v in scaling.items()},

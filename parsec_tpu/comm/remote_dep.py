@@ -38,8 +38,9 @@ import numpy as np
 
 from ..core import termdet as termdet_mod
 from ..utils import mca, output
-from .engine import (CommEngine, TAG_CNT_AGG, TAG_DTD_AUDIT, TAG_INTERNAL_GET,
-                     TAG_INTERNAL_PUT, TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
+from .engine import (CAP_STREAMING, CommEngine, TAG_CNT_AGG, TAG_DTD_AUDIT,
+                     TAG_INTERNAL_GET, TAG_INTERNAL_PUT,
+                     TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
 
 mca.register("comm_eager_limit", 65536,
              "Payloads up to this many bytes ride inside the activate AM", type=int)
@@ -401,7 +402,6 @@ class RemoteDepEngine:
     def _do_send(self, tp, tile_key, version, ranks, payload) -> None:
         algo = mca.get("comm_coll_bcast", "chain")
         eager_limit = mca.get("comm_eager_limit", 65536)
-        from .engine import CAP_STREAMING
         if (self.ce.capabilities & CAP_STREAMING) and \
                 mca.is_default("comm_eager_limit"):
             # ordered-stream transport: the payload crosses the same pipe

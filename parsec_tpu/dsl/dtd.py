@@ -210,7 +210,8 @@ class DTDTaskClass(TaskClass):
 class DTDTaskpool(Taskpool):
     """Ref: parsec_dtd_taskpool_new (insert_function.c:1513)."""
 
-    def __init__(self, context: Context, name: str = "dtd") -> None:
+    def __init__(self, context: Context, name: str = "dtd",
+                 capture: bool = False) -> None:
         # per-context (i.e. per-rank) sequence number per base name: every
         # rank constructs its taskpools in the same order, so "dtd#3" means
         # the same pool on all ranks while two concurrently-live pools can
@@ -248,6 +249,15 @@ class DTDTaskpool(Taskpool):
         # termdet can never observe transiently-zero counters at enqueue time
         # (the reference keeps the taskpool's own nb_pending_actions pinned
         # while attached)
+        # whole-DAG capture mode (dsl/capture.py): record inserts, execute
+        # the entire pool as ONE jitted XLA program at wait()
+        self._capture = None
+        if capture:
+            if context.nb_ranks > 1:
+                output.fatal("graph capture is single-rank "
+                             "(a captured pool never leaves the chip)")
+            from .capture import GraphCapture
+            self._capture = GraphCapture(self)
         self.addto_nb_pending_actions(1)
         self._open = True
         context.add_taskpool(self)
@@ -326,6 +336,10 @@ class DTDTaskpool(Taskpool):
         """
         if not self._open:
             output.fatal("insert_task on a closed DTD taskpool")
+        if self._capture is not None:
+            self._capture.record(fn, args, jit=jit, name=name or "")
+            self.inserted += 1
+            return None
         flow_accesses: List[int] = []
         arg_spec: List[Tuple[str, Any]] = []
         tiles: List[DTDTile] = []
@@ -675,6 +689,9 @@ class DTDTaskpool(Taskpool):
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """parsec_dtd_taskpool_wait: drain everything this rank executes."""
+        if self._capture is not None:
+            self._capture.execute()
+            return True
         if self._audit and self.ctx.comm is not None and self.ctx.nb_ranks > 1:
             # replay audit BEFORE blocking on completion: a divergent insert
             # sequence surfaces as a fatal here instead of a silent hang
@@ -690,6 +707,10 @@ class DTDTaskpool(Taskpool):
 
     def close(self) -> None:
         """End of insertion: drop the open action so termination can fire."""
+        if self._capture is not None and self._capture.ops:
+            # scheduler-mode inserts execute without an explicit wait();
+            # captured ops must not be silently dropped on close
+            self._capture.execute()
         if self._open:
             self._open = False
             self.addto_nb_pending_actions(-1)

@@ -1,0 +1,176 @@
+"""Graph-capture tests: a DTD taskpool compiled into one XLA executable.
+
+The capture mode (dsl/capture.py) must produce bit-for-bit the same tile
+results as the task-by-task scheduler on the same DAGs, cache compiled
+programs across identical DAG shapes, and reject what it cannot capture.
+"""
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.data.matrix import TwoDimBlockCyclic
+from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+from parsec_tpu.ops.gemm import insert_gemm_tasks
+from parsec_tpu.ops.potrf import insert_potrf_tasks, make_spd
+
+
+@pytest.fixture()
+def ctx():
+    c = pt.Context(nb_cores=1)
+    yield c
+    c.fini()
+
+
+def _gemm_collections(prefix, n, ts, a, b):
+    A = TwoDimBlockCyclic(prefix + "A", n, n, ts, ts, P=1, Q=1)
+    B = TwoDimBlockCyclic(prefix + "B", n, n, ts, ts, P=1, Q=1)
+    C = TwoDimBlockCyclic(prefix + "C", n, n, ts, ts, P=1, Q=1)
+    A.fill(lambda m, k: a[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    B.fill(lambda m, k: b[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    C.fill(lambda m, k: np.zeros((ts, ts), np.float32))
+    return A, B, C
+
+
+@pytest.mark.parametrize("batch_k", [False, True])
+def test_capture_gemm_matches_scheduler(ctx, batch_k):
+    n, ts = 64, 16
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+
+    _, _, Cs = _gemm_collections("s", n, ts, a, b)
+    As, Bs, _ = _gemm_collections("s2", n, ts, a, b)
+    tp = DTDTaskpool(ctx, "sched-gemm")
+    insert_gemm_tasks(tp, As, Bs, Cs, batch_k=batch_k)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=30)
+
+    Ac, Bc, Cc = _gemm_collections("c", n, ts, a, b)
+    cap = DTDTaskpool(ctx, "cap-gemm", capture=True)
+    insert_gemm_tasks(cap, Ac, Bc, Cc, batch_k=batch_k)
+    assert cap.inserted == tp.inserted
+    cap.wait()
+    cap.close()
+    ctx.wait(timeout=30)
+
+    np.testing.assert_allclose(np.asarray(Cc.to_dense()),
+                               np.asarray(Cs.to_dense()), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(Cc.to_dense()), a @ b,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_capture_potrf_matches_scheduler(ctx):
+    """The serial-critical-path DAG where capture matters most: POTRF's
+    panel chain becomes one executable."""
+    n, ts = 64, 16
+    spd = make_spd(n, seed=9)
+
+    P1 = TwoDimBlockCyclic("pS", n, n, ts, ts, P=1, Q=1)
+    P1.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    tp = DTDTaskpool(ctx, "sched-potrf")
+    insert_potrf_tasks(tp, P1)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=30)
+
+    P2 = TwoDimBlockCyclic("pC", n, n, ts, ts, P=1, Q=1)
+    P2.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    cap = DTDTaskpool(ctx, "cap-potrf", capture=True)
+    insert_potrf_tasks(cap, P2)
+    cap.wait()
+    cap.close()
+    ctx.wait(timeout=30)
+
+    got = np.tril(np.asarray(P2.to_dense(), dtype=np.float64))
+    ref = np.tril(np.asarray(P1.to_dense(), dtype=np.float64))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got, np.linalg.cholesky(spd.astype(np.float64)),
+                               rtol=0, atol=2e-2)
+
+
+def test_capture_program_cache(ctx):
+    """Identical DAG shapes reuse the compiled executable; a changed shape
+    recompiles."""
+    n, ts = 32, 16
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+
+    A, B, C = _gemm_collections("h", n, ts, a, b)
+    cap = DTDTaskpool(ctx, "cache-gemm", capture=True)
+    insert_gemm_tasks(cap, A, B, C, batch_k=True)
+    cap.wait()
+    assert not cap._capture.cache_hit        # first shape: compile
+    insert_gemm_tasks(cap, A, B, C, batch_k=True)
+    cap.wait()
+    assert cap._capture.cache_hit            # same shape: cached
+    assert cap._capture.executions == 2
+    cap.close()
+    ctx.wait(timeout=30)
+    # C accumulated the product twice
+    np.testing.assert_allclose(np.asarray(C.to_dense()), 2 * (a @ b),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_capture_rejects_nonjit_and_multirank(ctx):
+    cap = DTDTaskpool(ctx, "cap-neg", capture=True)
+    t = cap.tile_new((4, 4), np.float32)
+    with pytest.raises(RuntimeError, match="jit-traceable"):
+        cap.insert_task(lambda x: x, (t, RW), jit=False)
+    cap.close()
+
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+
+    def program(rank, fabric):
+        c = pt.Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(c, ThreadsCE(fabric, rank))
+        try:
+            DTDTaskpool(c, "cap2", capture=True)
+            return "accepted"
+        except RuntimeError as e:
+            return str(e)
+        finally:
+            c.fini(timeout=5)
+
+    results = run_distributed(2, program, timeout=30)
+    assert all("single-rank" in r for r in results)
+
+
+def test_capture_close_executes_pending(ctx):
+    """close() without wait() must execute the recorded DAG, matching
+    scheduler semantics where inserted tasks run without an explicit
+    taskpool wait."""
+    cap = DTDTaskpool(ctx, "cap-close", capture=True)
+    t = cap.tile_new((4, 4), np.float32)
+    t.data.create_copy(0, np.ones((4, 4), np.float32))
+    cap.insert_task(lambda x: x + 1.0, (t, RW))
+    cap.close()                     # no wait()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 2.0)
+    assert cap._capture.executions == 1
+
+
+def test_capture_mixed_value_args(ctx):
+    """Scalar params bake into the trace; ndarray params ride as inputs."""
+    cap = DTDTaskpool(ctx, "cap-mixed", capture=True)
+    t = cap.tile_new((4, 4), np.float32)
+    host = cap.tile_new((4, 4), np.float32)
+    t.data.create_copy(0, np.ones((4, 4), np.float32))
+    host.data.create_copy(0, np.zeros((4, 4), np.float32))
+    bias = np.full((4, 4), 0.5, np.float32)
+
+    def scale_add(x, alpha, b):
+        return x * alpha + b
+
+    cap.insert_task(scale_add, (t, RW), 3.0, bias)
+    cap.insert_task(lambda dst, s: dst + s, (host, RW), (t, READ))
+    cap.wait()
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(host.data.newest_copy().payload),
+                               3.0 + 0.5)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload),
+                               3.0 + 0.5)
