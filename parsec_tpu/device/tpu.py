@@ -152,6 +152,12 @@ class TPUDevice(DeviceModule):
         Only one thread at a time is the manager (try-lock = the CAS in
         device_gpu.c:3398-3424); others return immediately after enqueueing.
         """
+        if not self._pending and not self._inflight:
+            # idle fast-path: this poll sits in every hot-loop iteration,
+            # and CPU-chore-only workloads must not pay the manager lock +
+            # MCA lookups per loop (an enqueue racing this check is picked
+            # up on the very next iteration — the enqueue sets work_event)
+            return 0
         if not self._manager_lock.acquire(blocking=False):
             return 0
         try:

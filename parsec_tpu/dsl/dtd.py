@@ -316,8 +316,12 @@ class DTDTaskpool(Taskpool):
             tc.release_deps = self._release_deps
             tc.complete_execution = self._complete_execution
             # the TPU chore only exists where a TPU device does — on
-            # CPU-only contexts every task would walk (and fail) it first
-            if any(d.type & DEV_TPU for d in self.ctx.devices.devices):
+            # CPU-only contexts every task would walk (and fail) it first.
+            # Non-jittable bodies never get one: they would ride the whole
+            # async device pipeline (stage-in/events/epilog) only to run
+            # raw Python anyway — pure per-task overhead
+            if jit_ok and any(d.type & DEV_TPU
+                              for d in self.ctx.devices.devices):
                 tc.add_chore(Chore(DEV_TPU, self._tpu_hook))
             tc.add_chore(Chore(DEV_CPU, self._cpu_hook))
             self.add_task_class(tc)
