@@ -214,7 +214,8 @@ class ALPerf(PinsModule):
         return r
 
 
-def ptg_to_dtd_replay(ptg_taskpool, ctx, name: Optional[str] = None):
+def ptg_to_dtd_replay(ptg_taskpool, ctx, name: Optional[str] = None,
+                      capture: bool = False):
     """Replay a PTG taskpool's task space through the DTD frontend.
 
     The cross-DSL harness (ref: pins/ptg_to_dtd): enumerate the PTG task
@@ -223,14 +224,19 @@ def ptg_to_dtd_replay(ptg_taskpool, ctx, name: Optional[str] = None):
     dataflow through tiles; results must match the PTG execution.
     Returns the DTD taskpool (caller waits/closes).
 
-    Limitation mirroring the module's scope: tasks exchanging *anonymous*
-    intermediate flows (task->task with no memory backing) are replayed
-    through per-flow scratch tiles keyed by (class, key, flow).
+    Anonymous task→task flows ride per-flow scratch tiles keyed by the
+    PRODUCER (class, key, flow); memory out-deps copy home (the replay
+    analogue of PTG's complete-execution write-back).
+
+    With ``capture=True`` the replay lands in a captured pool
+    (dsl/capture.py): a PTG program — a static task space by definition —
+    compiles into ONE XLA executable. PTG bodies are jitted already, so
+    the replay wrappers trace through.
     """
     from ..dsl.dtd import DTDTaskpool, READ, RW, WRITE
     from ..dsl.ptg.compiler import PTGTaskpool, _payload_of
     assert isinstance(ptg_taskpool, PTGTaskpool)
-    tp = DTDTaskpool(ctx, name or f"{ptg_taskpool.name}-dtd")
+    tp = DTDTaskpool(ctx, name or f"{ptg_taskpool.name}-dtd", capture=capture)
     spec = ptg_taskpool.program.spec
 
     scratch: Dict[Any, Any] = {}
@@ -267,7 +273,8 @@ def ptg_to_dtd_replay(ptg_taskpool, ctx, name: Optional[str] = None):
         params = [loc[p] for p in tcs.params]
         # reuse the PTG-compiled body through a DTD-shaped wrapper
         fn = _dtd_wrapper_for(ptg_taskpool, tcs, tc)
-        tp.insert_task(fn, *args, *params, name=f"{tcs.name}-replay", jit=False)
+        tp.insert_task(fn, *args, *params, name=f"{tcs.name}-replay",
+                       jit=capture)
         # route written outputs onward: memory out-deps write home like PTG;
         # task out-deps land in the successor's scratch tile
         _route_outputs(ptg_taskpool, tp, tc, tcs, loc, env, args, scratch_tile)
@@ -288,10 +295,21 @@ def _dtd_wrapper_for(ptp, tcs, tc):
     return wrapper
 
 
+def _replay_copy(d_, s_):
+    return s_
+
+
 def _route_outputs(ptp, tp, tc, tcs, loc, env, args, scratch_tile) -> None:
-    """After the replayed task, copy its written flows into the tiles that
-    successor replays will read (task-endpoint out-deps)."""
+    """After the replayed task, publish its written flows where successor
+    replays will read them. Scratch tiles are keyed by the PRODUCER
+    (class, key, flow) — the key a consumer's input endpoint names
+    ("C GEMM(m,n,k-1)" reads scratch(GEMM, (m,n,k-1), C)) — and memory
+    out-deps copy home, the replay analogue of PTG's complete-execution
+    write-back."""
+    import itertools
+
     from ..dsl.dtd import READ, RW
+    from ..dsl.ptg.compiler import _index_expr
     flow_tiles = {}
     di = 0
     for fs in tcs.flows:
@@ -299,14 +317,17 @@ def _route_outputs(ptp, tp, tc, tcs, loc, env, args, scratch_tile) -> None:
             continue
         flow_tiles[fs.name] = args[di][0]
         di += 1
+    jit_copy = getattr(tp, "_capture", None) is not None
     for fs in tcs.flows:
         if fs.access not in ("WRITE", "RW"):
             continue
+        src = flow_tiles[fs.name]
+        has_task_out = False
         for d in fs.deps:
             if d.direction != "out":
                 continue
             for ep, neg in ((d.endpoint, False), (d.else_endpoint, True)):
-                if ep is None or ep.kind != "task":
+                if ep is None:
                     continue
                 if d.guard is not None:
                     v = bool(eval(compile(d.guard, "<g>", "eval"), dict(env)))  # noqa: S307
@@ -314,14 +335,21 @@ def _route_outputs(ptp, tp, tc, tcs, loc, env, args, scratch_tile) -> None:
                         v = not v
                     if not v:
                         continue
-                import itertools
-                from ..dsl.ptg.compiler import _index_expr
-                exprs = [_index_expr(e) for e in ep.index_exprs]
-                axes = [ex.values(env) for ex in exprs]
-                for combo in itertools.product(*axes):
-                    dst = scratch_tile(ep.name, tuple(combo), ep.flow)
-                    src = flow_tiles[fs.name]
-                    if dst is src:
-                        continue
-                    tp.insert_task(lambda d_, s_: s_, (dst, RW), (src, READ),
-                                   name="replay-copy", jit=False)
+                if ep.kind == "task":
+                    has_task_out = True
+                elif ep.kind == "memory":
+                    exprs = [_index_expr(e) for e in ep.index_exprs]
+                    for combo in itertools.product(
+                            *[ex.values(env) for ex in exprs]):
+                        dc = ptp.collections[ep.name]
+                        dst = tp.tile_of(dc, *combo)
+                        if dst is not src:
+                            tp.insert_task(_replay_copy, (dst, RW),
+                                           (src, READ), name="replay-copy",
+                                           jit=jit_copy)
+        if has_task_out:
+            # one producer-keyed publication serves every consumer
+            dst = scratch_tile(tcs.name, tuple(loc.values()), fs.name)
+            if dst is not src:
+                tp.insert_task(_replay_copy, (dst, RW), (src, READ),
+                               name="replay-copy", jit=jit_copy)

@@ -174,3 +174,73 @@ def test_capture_mixed_value_args(ctx):
                                3.0 + 0.5)
     np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload),
                                3.0 + 0.5)
+
+
+def test_capture_ptg_via_replay(ctx):
+    """A PTG program — static task space — compiled into ONE XLA executable
+    through the cross-DSL replay (ptg_to_dtd + capture): tile GEMM results
+    match the PTG scheduler execution."""
+    from parsec_tpu.core.pins_modules import ptg_to_dtd_replay
+    from parsec_tpu.data.matrix import TiledMatrix
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+
+    src = """
+%global MT
+%global KT
+%global descA
+%global descB
+%global descC
+
+GEMM(m, n, k)
+  m = 0 .. MT-1
+  n = 0 .. MT-1
+  k = 0 .. KT-1
+  : descC(m, n)
+  READ A <- descA(m, k)
+  READ B <- descB(k, n)
+  RW   C <- (k == 0) ? descC(m, n) : C GEMM(m, n, k-1)
+       -> (k < KT-1) ? C GEMM(m, n, k+1) : descC(m, n)
+BODY
+  C = C + jnp.dot(A, B, preferred_element_type=jnp.float32)
+END
+"""
+    MT = KT = 2
+    TS = 8
+    rng = np.random.default_rng(13)
+    a = rng.standard_normal((MT*TS, KT*TS)).astype(np.float32)
+    b = rng.standard_normal((KT*TS, MT*TS)).astype(np.float32)
+
+    def mats(prefix):
+        A = TiledMatrix(prefix + "A", MT*TS, KT*TS, TS, TS)
+        B = TiledMatrix(prefix + "B", KT*TS, MT*TS, TS, TS)
+        Cm = TiledMatrix(prefix + "C", MT*TS, MT*TS, TS, TS)
+        A.fill(lambda m, k: a[m*TS:(m+1)*TS, k*TS:(k+1)*TS])
+        B.fill(lambda k, n: b[k*TS:(k+1)*TS, n*TS:(n+1)*TS])
+        Cm.fill(lambda m, n: np.zeros((TS, TS), np.float32))
+        return A, B, Cm
+
+    # scheduler PTG execution
+    A1, B1, C1 = mats("rs")
+    prog = compile_ptg(src, "capgemm")
+    ptp = prog.instantiate(ctx, globals={"MT": MT, "KT": KT},
+                           collections={"descA": A1, "descB": B1, "descC": C1})
+    ctx.add_taskpool(ptp)
+    ctx.wait(timeout=60)
+
+    # captured replay of the same program
+    A2, B2, C2 = mats("rc")
+    ptp2 = prog.instantiate(ctx, globals={"MT": MT, "KT": KT},
+                            collections={"descA": A2, "descB": B2,
+                                         "descC": C2}, name="capgemm2")
+    dtp = ptg_to_dtd_replay(ptp2, ctx, capture=True)
+    assert dtp._capture is not None
+    dtp.wait()
+    dtp.close()
+    ctx.wait(timeout=60)
+    assert dtp._capture.executions == 1
+
+    # replay writes through the same C tiles the PTG version wrote
+    np.testing.assert_allclose(np.asarray(C2.to_dense()),
+                               np.asarray(C1.to_dense()), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(C2.to_dense()), a @ b,
+                               rtol=1e-4, atol=1e-4)

@@ -143,10 +143,10 @@ END
     dtp.wait()
     dtp.close()
     ctx.wait()
-    # the replay wrote through the same collection tiles
-    # chain: X flows through scratch tiles; final write-back is a PTG-only
-    # complete-execution step, so check the last scratch value instead
     assert dtp.executed >= NT
+    # the chain's memory out-dep wrote home: A(0,0) saw NT increments
+    np.testing.assert_allclose(np.asarray(A.data_of(0, 0).newest_copy().payload),
+                               float(NT))
 
 
 # ----------------------------------------------------- comm-stream tracing
