@@ -96,3 +96,86 @@ class DotGrapher:
         with open(path, "w") as f:
             f.write(dot)
         return path
+
+    # -------------------------------------------------------- image render
+    def _layers(self) -> List[List[str]]:
+        """Longest-path layering of the recorded DAG (topological rows)."""
+        with self._lock:
+            nodes = set(self._nodes)
+            preds: Dict[str, List[str]] = {n: [] for n in nodes}
+            succs: Dict[str, List[str]] = {n: [] for n in nodes}
+            for s, d, _ in self._edges:
+                if s in nodes and d in nodes:
+                    preds[d].append(s)
+                    succs[s].append(d)
+        depth: Dict[str, int] = {}
+        remaining = dict((n, len(preds[n])) for n in nodes)
+        frontier = [n for n, c in remaining.items() if c == 0]
+        while frontier:
+            nxt = []
+            for n in frontier:
+                depth.setdefault(n, 0)
+                for m in succs[n]:
+                    depth[m] = max(depth.get(m, 0), depth[n] + 1)
+                    remaining[m] -= 1
+                    if remaining[m] == 0:
+                        nxt.append(m)
+            frontier = nxt
+        for n in nodes:           # cycles/unreached degrade to layer 0
+            depth.setdefault(n, 0)
+        by_layer: Dict[int, List[str]] = {}
+        for n, d in depth.items():
+            by_layer.setdefault(d, []).append(n)
+        return [sorted(by_layer[d]) for d in sorted(by_layer)]
+
+    def to_svg(self, name: str = "parsec_tpu") -> str:
+        """Self-contained SVG of the executed DAG: layered layout, one color
+        per task class, straight dependency edges — the dbp-dot2png role
+        (ref: tools/profiling dbp-dot2png) without an external graphviz."""
+        layers = self._layers()
+        with self._lock:
+            nodes = dict(self._nodes)
+            edges = sorted(self._edges)
+        classes = sorted({c for c, _ in nodes.values()})
+        color = {c: _COLORS[i % len(_COLORS)] for i, c in enumerate(classes)}
+        bw, bh, hgap, vgap, pad = 130, 28, 24, 56, 20
+        pos: Dict[str, Tuple[float, float]] = {}
+        width = pad * 2 + max((len(l) for l in layers), default=1) * (bw + hgap)
+        for li, layer in enumerate(layers):
+            row_w = len(layer) * (bw + hgap) - hgap
+            x0 = (width - row_w) / 2
+            for ni, n in enumerate(layer):
+                pos[n] = (x0 + ni * (bw + hgap), pad + li * (bh + vgap))
+        height = pad * 2 + len(layers) * (bh + vgap) - vgap if layers else pad * 2
+        out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+               f'height="{height}" font-family="monospace" font-size="11">',
+               f'<title>{name}</title>',
+               '<defs><marker id="arr" markerWidth="8" markerHeight="8" '
+               'refX="7" refY="3" orient="auto"><path d="M0,0 L7,3 L0,6 z" '
+               'fill="#555"/></marker></defs>']
+        for s, d, flow in edges:
+            if s not in pos or d not in pos:
+                continue
+            x1, y1 = pos[s][0] + bw / 2, pos[s][1] + bh
+            x2, y2 = pos[d][0] + bw / 2, pos[d][1]
+            out.append(f'<line x1="{x1:.0f}" y1="{y1:.0f}" x2="{x2:.0f}" '
+                       f'y2="{y2:.0f}" stroke="#555" stroke-width="1" '
+                       f'marker-end="url(#arr)"/>')
+            if flow:
+                out.append(f'<text x="{(x1+x2)/2:.0f}" y="{(y1+y2)/2:.0f}" '
+                           f'fill="#555">{flow}</text>')
+        for n, (x, y) in pos.items():
+            cls, th = nodes[n]
+            out.append(f'<rect x="{x:.0f}" y="{y:.0f}" width="{bw}" '
+                       f'height="{bh}" rx="6" fill="{color[cls]}" '
+                       f'stroke="#333"><title>thread {th}</title></rect>')
+            label = n if len(n) <= 18 else n[:17] + "…"
+            out.append(f'<text x="{x + bw/2:.0f}" y="{y + bh/2 + 4:.0f}" '
+                       f'text-anchor="middle" fill="#fff">{label}</text>')
+        out.append("</svg>")
+        return "\n".join(out)
+
+    def dump_svg(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_svg())
+        return path

@@ -307,3 +307,17 @@ def test_check_comms_reads_otf2_archives(tmp_path):
     summary = check_comms(paths)
     assert summary["errors"] == [], summary
     assert summary["counts"]["activate_snd"] > 0
+
+
+def test_dag_svg_render(ctx, tmp_path):
+    """The dbp-dot2png role without graphviz: the executed DAG renders to a
+    self-contained SVG with layered nodes and dependency arrows."""
+    g = DotGrapher()
+    g.enable(ctx)
+    _run_chain(ctx, 4)
+    svg = g.to_svg()
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    assert svg.count("<rect") == 4          # 4 chained tasks
+    assert svg.count("<line") == 3          # 3 dependency edges
+    p = g.dump_svg(str(tmp_path / "dag.svg"))
+    assert open(p).read() == svg
