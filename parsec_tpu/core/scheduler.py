@@ -221,8 +221,20 @@ class _LocalQueuesBase(SchedulerModule):
         start = self._order.index(me) if me in self._order else 0
         order = [self._order[(start + d) % n] for d in range(1, n)]
         my_vp = getattr(stream, "vp_id", 0)
-        order.sort(key=lambda tid: 0 if
-                   self.context.streams[tid].vp_id == my_vp else 1)
+        # sort victims by (same-VP first, NUMA core distance, ring order —
+        # the stable sort preserves ring position as the final tiebreak):
+        # the hwloc-distance steal walk of the reference's flow_init
+        vmap = getattr(self.context, "vpmap", None)
+        if vmap is not None:
+            from .vpmap import core_distance_fn
+            dist = core_distance_fn()
+            my_core = vmap.core_of(me)
+            order.sort(key=lambda tid: (
+                0 if self.context.streams[tid].vp_id == my_vp else 1,
+                dist(my_core, vmap.core_of(tid))))
+        else:
+            order.sort(key=lambda tid: 0 if
+                       self.context.streams[tid].vp_id == my_vp else 1)
         self._steal_cache[me] = order
         return order
 

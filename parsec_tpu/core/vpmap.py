@@ -105,6 +105,79 @@ class VPMap:
         return self.vps[-1].cores[-1]
 
 
+_SYS_NODE = "/sys/devices/system/node"
+
+
+def _parse_cpulist(text: str) -> List[int]:
+    """"0-3,7,9-10" -> [0,1,2,3,7,9,10] (the sysfs cpulist format)."""
+    out: List[int] = []
+    for part in text.strip().split(","):
+        if not part:
+            continue
+        lo, _, hi = part.partition("-")
+        if hi:
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(lo))
+    return out
+
+
+def numa_topology(base: str = _SYS_NODE):
+    """Discover (core -> NUMA node, node-distance matrix) from sysfs —
+    the hwloc-distances role (ref: parsec_hwloc.c distance queries feeding
+    the schedulers' steal-locality walk). Single-node / non-Linux hosts
+    degrade to one node at self-distance 10 (the ACPI SLIT convention)."""
+    core_node: dict = {}
+    dists: dict = {}
+    try:
+        for entry in sorted(os.listdir(base)):
+            if not entry.startswith("node") or not entry[4:].isdigit():
+                continue
+            node = int(entry[4:])
+            try:
+                with open(os.path.join(base, entry, "cpulist")) as f:
+                    for c in _parse_cpulist(f.read()):
+                        core_node[c] = node
+                with open(os.path.join(base, entry, "distance")) as f:
+                    dists[node] = [int(x) for x in f.read().split()]
+            except OSError:
+                continue
+    except OSError:
+        pass
+    if not core_node:
+        for c in available_cores():
+            core_node[c] = 0
+        dists[0] = [10]
+    return core_node, dists
+
+
+_core_distance_cache = None
+
+
+def core_distance_fn(base: str = _SYS_NODE):
+    """A cached ``f(core_a, core_b) -> int`` over the NUMA distance matrix
+    (10 = same node, larger = farther; unknown cores treated as node 0)."""
+    global _core_distance_cache
+    if _core_distance_cache is None or base != _SYS_NODE:
+        core_node, dists = numa_topology(base)
+        nodes = sorted(dists)
+
+        def distance(a: int, b: int) -> int:
+            na, nb = core_node.get(a, 0), core_node.get(b, 0)
+            row = dists.get(na)
+            if row is None or nb >= len(row):
+                return 10 if na == nb else 20
+            # sysfs rows are ordered by target node id
+            try:
+                return row[nodes.index(nb)]
+            except ValueError:
+                return 20
+        if base != _SYS_NODE:
+            return distance
+        _core_distance_cache = distance
+    return _core_distance_cache
+
+
 def bind_current_thread(core: int) -> bool:
     """parsec_bindthread: pin the calling thread (best effort)."""
     try:

@@ -129,6 +129,54 @@ def to_chrome_trace(trace: TraceData) -> Dict[str, Any]:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+_SVG_COLORS = ["#4c72b0", "#dd8452", "#55a868", "#c44e52", "#8172b3",
+               "#937860", "#da8bc3", "#8c8c8c", "#ccb974", "#64b5cd"]
+
+
+def to_animated_svg(trace: TraceData, playback_s: float = 5.0) -> str:
+    """Self-contained animated SVG: a Gantt of the execution that draws
+    itself in playback order (SMIL timing) — the role of the reference's
+    trace animation tool (tools/profiling/animation.c), with no external
+    renderer. One lane per stream, one color per keyword; each task
+    interval fades in at its (scaled) begin time."""
+    ivs = list(_intervals(trace))
+    if not ivs:
+        return "<svg xmlns='http://www.w3.org/2000/svg'/>"
+    t0 = min(iv[5] for iv in ivs)
+    t1 = max(iv[6] for iv in ivs)
+    span = max(t1 - t0, 1e-9)
+    lane_h, pad, width = 26, 30, 960
+    lanes = len(trace.streams)
+    height = pad * 2 + lanes * lane_h
+    color = {d["key"]: _SVG_COLORS[i % len(_SVG_COLORS)]
+             for i, d in enumerate(trace.dictionary)}
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+           f'height="{height}" font-family="monospace" font-size="10">']
+    for si, s in enumerate(trace.streams):
+        y = pad + si * lane_h
+        out.append(f'<text x="2" y="{y + lane_h - 10}" '
+                   f'fill="#333">{s["name"][:14]}</text>')
+        out.append(f'<line x1="{pad + 90}" y1="{y + lane_h - 4}" '
+                   f'x2="{width - 10}" y2="{y + lane_h - 4}" '
+                   f'stroke="#ddd"/>')
+    x0, x1 = pad + 90, width - 10
+    for si, sname, base, eid, tpid, tb, te, info in ivs:
+        bx = x0 + (tb - t0) / span * (x1 - x0)
+        w = max((te - tb) / span * (x1 - x0), 1.0)
+        y = pad + si * lane_h
+        begin = (tb - t0) / span * playback_s
+        name = trace.dictionary[base]["name"]
+        out.append(
+            f'<rect x="{bx:.1f}" y="{y + 4}" width="{w:.1f}" '
+            f'height="{lane_h - 10}" fill="{color[base]}" opacity="0">'
+            f'<title>{name} #{eid} [{(tb - t0)*1e3:.2f}..'
+            f'{(te - t0)*1e3:.2f} ms]</title>'
+            f'<set attributeName="opacity" to="0.9" '
+            f'begin="{begin:.3f}s" fill="freeze"/></rect>')
+    out.append("</svg>")
+    return "\n".join(out)
+
+
 def read_otf2(path: str) -> TraceData:
     """Read a PTF2 archive (the OTF2-class backend) into the same model as
     PBP files, so the whole analysis pipeline is format-agnostic."""
@@ -220,7 +268,8 @@ def check_comms(paths: List[str]) -> Dict[str, Any]:
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
-        print("usage: trace_reader <trace.pbp> [--ctf out.json] [--csv out.csv]\n"
+        print("usage: trace_reader <trace.pbp|archive.ptf2> "
+              "[--ctf out.json] [--csv out.csv] [--svg out.svg]\n"
               "       trace_reader --check-comms <rank0.pbp> <rank1.pbp> ...",
               file=sys.stderr)
         return 2
@@ -241,7 +290,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         out = argv[argv.index("--csv") + 1]
         to_dataframe(trace).to_csv(out, index=False)
         print(f"trace tables -> {out}")
-    if "--ctf" not in argv and "--csv" not in argv:
+    if "--svg" in argv:
+        out = argv[argv.index("--svg") + 1]
+        with open(out, "w") as f:
+            f.write(to_animated_svg(trace))
+        print(f"animated gantt -> {out}")
+    if not any(f in argv for f in ("--ctf", "--csv", "--svg")):
         df = to_dataframe(trace)
         if len(df):
             print(df.groupby("name")["duration"].describe())

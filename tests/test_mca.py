@@ -51,3 +51,56 @@ def test_on_change_and_help():
     assert seen == [5]
     assert "watched" in r.help_text()
     assert "help me" in r.help_text()
+
+
+# ------------------------------------------------- NUMA topology distances
+
+def test_numa_topology_parse(tmp_path):
+    """sysfs NUMA discovery: cpulist + SLIT distance rows (the hwloc
+    distance-matrix role)."""
+    from parsec_tpu.core.vpmap import (_parse_cpulist, core_distance_fn,
+                                       numa_topology)
+
+    assert _parse_cpulist("0-3,7,9-10") == [0, 1, 2, 3, 7, 9, 10]
+    base = tmp_path / "node"
+    for node, (cpus, dist) in enumerate([("0-1", "10 21"), ("2-3", "21 10")]):
+        d = base / f"node{node}"
+        d.mkdir(parents=True)
+        (d / "cpulist").write_text(cpus + "\n")
+        (d / "distance").write_text(dist + "\n")
+    core_node, dists = numa_topology(str(base))
+    assert core_node == {0: 0, 1: 0, 2: 1, 3: 1}
+    assert dists == {0: [10, 21], 1: [21, 10]}
+    f = core_distance_fn(str(base))
+    assert f(0, 1) == 10       # same node
+    assert f(0, 2) == 21       # cross node
+    assert f(3, 2) == 10
+    assert f(2, 0) == 21
+
+
+def test_numa_topology_fallback_single_node():
+    """A host without sysfs NUMA data degrades to one node at distance 10."""
+    from parsec_tpu.core.vpmap import core_distance_fn, numa_topology
+    core_node, dists = numa_topology("/nonexistent-sysfs-path")
+    assert set(dists) == {0} and dists[0] == [10]
+    f = core_distance_fn("/nonexistent-sysfs-path")
+    assert f(0, 1) == 10
+
+
+def test_steal_order_prefers_near_cores():
+    """The scheduler's steal walk sorts victims by (same VP, NUMA core
+    distance, ring order) — the hwloc-distance walk of the reference's
+    flow_init."""
+    from parsec_tpu.core.context import Context
+
+    ctx = Context(nb_cores=4)
+    try:
+        sched = ctx.sched
+        if not hasattr(sched, "_steal_order"):
+            pytest.skip("scheduler has no steal walk")
+        order = sched._steal_order(ctx.streams[0])
+        assert sorted(order) == [1, 2, 3]
+        # on this host all cores share a node: pure ring order survives
+        assert order == [1, 2, 3]
+    finally:
+        ctx.fini()

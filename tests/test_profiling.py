@@ -321,3 +321,21 @@ def test_dag_svg_render(ctx, tmp_path):
     assert svg.count("<line") == 3          # 3 dependency edges
     p = g.dump_svg(str(tmp_path / "dag.svg"))
     assert open(p).read() == svg
+
+
+def test_animated_gantt_svg(ctx, tmp_path):
+    """The trace-animation role (tools/profiling/animation.c): a
+    self-drawing Gantt SVG with SMIL timing, from either trace format."""
+    from parsec_tpu.tools import trace_reader
+    from parsec_tpu.tools.trace_reader import read_trace, to_animated_svg
+
+    prof = Profiling()
+    TaskProfiler(prof).enable(ctx)
+    _run_chain(ctx, 6)
+    path = prof.dump(str(tmp_path / "anim.pbp"))
+    svg = to_animated_svg(read_trace(path))
+    assert svg.count("<rect") == 6
+    assert svg.count("<set attributeName=") == 6       # SMIL playback
+    out = str(tmp_path / "anim.svg")
+    assert trace_reader.main([path, "--svg", out]) == 0
+    assert open(out).read().startswith("<svg")

@@ -1,0 +1,53 @@
+" Vim syntax highlighting for parsec_tpu PTG sources (.ptg / .jdf-style)
+" (the tools/vim_syntax role of the reference, adapted to this dialect).
+" Install:  cp tools/vim_syntax/ptg.vim ~/.vim/syntax/
+"           autocmd BufRead,BufNewFile *.ptg set filetype=ptg
+
+if exists("b:current_syntax")
+  finish
+endif
+
+syn case match
+
+" directives
+syn match   ptgDirective    "^\s*%\(global\|option\|prologue\)\>"
+syn region  ptgPrologue     start="^\s*%{" end="^\s*%}" contains=@Python
+
+" task headers:  NAME(a, b) [props]
+syn match   ptgTaskHeader   "^\w\+\s*([^)]*)\s*\(\[[^]]*\]\)\?\s*$"
+
+" parameter ranges:  k = 0 .. NT-1 [.. step]
+syn match   ptgRange        "^\s*\w\+\s*=\s*.\+\.\..\+$"
+
+" affinity:  : dc(k, n)
+syn match   ptgAffinity     "^\s*:\s*\w\+\s*([^)]*)"
+
+" flow access keywords + dep arrows
+syn keyword ptgAccess       READ WRITE RW CTL IN OUT
+syn keyword ptgSpecial      NEW NULL
+syn match   ptgArrow        "<-\|->"
+syn match   ptgAttrBlock    "\[[^]]*\]"
+
+" body blocks (python inside)
+syn region  ptgBody         start="^\s*BODY\(\s*\[[^]]*\]\)\?\s*$" end="^\s*END\s*$" contains=@Python keepend
+syn keyword ptgBodyKw       BODY END contained
+
+" properties:  priority = expr
+syn match   ptgProperty     "^\s*\(priority\|make_key_fn\|startup_fn\|time_estimate\)\s*="
+
+" comments
+syn match   ptgComment      "//.*$"
+
+hi def link ptgDirective    PreProc
+hi def link ptgTaskHeader   Function
+hi def link ptgRange        Identifier
+hi def link ptgAffinity     Type
+hi def link ptgAccess       Keyword
+hi def link ptgSpecial      Constant
+hi def link ptgArrow        Operator
+hi def link ptgAttrBlock    Special
+hi def link ptgBodyKw       Statement
+hi def link ptgProperty     PreProc
+hi def link ptgComment      Comment
+
+let b:current_syntax = "ptg"
