@@ -339,3 +339,21 @@ def test_animated_gantt_svg(ctx, tmp_path):
     out = str(tmp_path / "anim.svg")
     assert trace_reader.main([path, "--svg", out]) == 0
     assert open(out).read().startswith("<svg")
+
+
+def test_live_counter_view(ctx, tmp_path):
+    """The aggregator_visu GUI role: background counter sampling during a
+    run + a rendered time-series image (headless matplotlib)."""
+    from parsec_tpu.tools.live_view import LiveCounterView
+    from parsec_tpu.utils.counters import install_scheduler_counters
+
+    install_scheduler_counters(ctx)
+    view = LiveCounterView(interval_s=0.01)
+    view.start()
+    _run_chain(ctx, 32)
+    view.stop()
+    assert len(view.times) >= 2
+    active = view.active_series()
+    assert any("sched" in n or "task" in n for n in active), active
+    out = view.render(str(tmp_path / "counters.png"))
+    assert os.path.getsize(out) > 1000
