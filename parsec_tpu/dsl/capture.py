@@ -115,37 +115,48 @@ class GraphCapture:
                           for v in tile_vals)
         return (tuple(op_sig), tiles_sig)
 
-    def _build(self):
-        """The traced program: fold the op list over a tile-value env.
-        XLA recovers the DAG from value dependencies."""
+    def _written(self) -> List[int]:
         from .dtd import WRITE
+        return sorted({e[1] for _, spec in self.ops for e in spec
+                       if e[0] == "flow" and e[2] & WRITE})
+
+    @staticmethod
+    def _replay(ops, read, write, arr_vals) -> None:
+        """The shared op fold: replay bodies in insertion order against
+        tile read/write primitives (an env list for single-device capture;
+        slice/dynamic_update_slice of sharded globals for mesh capture).
+        XLA recovers the DAG from the value dependencies either way."""
+        from .dtd import WRITE
+        ai = 0
+        for fn, spec in ops:
+            ins, wixs = [], []
+            for e in spec:
+                if e[0] == "flow":
+                    ins.append(read(e[1]))
+                    if e[2] & WRITE:
+                        wixs.append(e[1])
+                elif e[0] == "scalar":
+                    ins.append(e[1])
+                else:
+                    ins.append(arr_vals[ai])
+                    ai += 1
+            outs = fn(*ins)
+            if outs is None:
+                outs = ()
+            elif not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for wi, out in zip(wixs, outs):
+                write(wi, out)
+
+    def _build(self):
+        """The single-device traced program: fold over a tile-value env."""
         ops = self.ops
-        written = sorted({e[1] for _, spec in ops for e in spec
-                          if e[0] == "flow" and e[2] & WRITE})
+        written = self._written()
 
         def program(tile_vals, arr_vals):
             env = list(tile_vals)
-            ai = 0
-            for fn, spec in ops:
-                ins = []
-                wixs = []
-                for e in spec:
-                    if e[0] == "flow":
-                        ins.append(env[e[1]])
-                        if e[2] & WRITE:
-                            wixs.append(e[1])
-                    elif e[0] == "scalar":
-                        ins.append(e[1])
-                    else:
-                        ins.append(arr_vals[ai])
-                        ai += 1
-                outs = fn(*ins)
-                if outs is None:
-                    outs = ()
-                elif not isinstance(outs, (tuple, list)):
-                    outs = (outs,)
-                for wi, out in zip(wixs, outs):
-                    env[wi] = out
+            GraphCapture._replay(ops, env.__getitem__, env.__setitem__,
+                                 arr_vals)
             return tuple(env[i] for i in written)
 
         return program, written
@@ -198,6 +209,186 @@ class GraphCapture:
         self.executions += 1
         # consume: a later insert batch into the same pool starts a fresh
         # capture (wait() executes each batch exactly once)
+        self.ops = []
+        self._tiles = []
+        self._tile_ix = {}
+
+    # ------------------------------------------------------- mesh execution
+    def execute_mesh(self, mesh, axis_names=None) -> None:
+        """Compile the captured DAG into ONE GSPMD program over a device
+        mesh: collection tiles become slices of per-collection GLOBAL
+        arrays sharded over the mesh, tile writes become
+        dynamic_update_slice — XLA partitions the ops across devices and
+        inserts the ICI transfers/collectives the dataflow implies. The
+        whole distributed DAG is a single launch.
+
+        v1 contract: collection-backed tiles must come from TiledMatrix
+        collections with uniform full tiles, and every global dimension
+        must divide by its mesh axis (checked; a failed validation
+        DISCARDS the recorded batch — it must not silently fall back to a
+        single-device execute at close()). Scratch (tile_new) tiles ride
+        as replicated inputs. Results scatter back to the tile copies
+        through one host assembly per written collection (on a real pod
+        you would keep the globals resident — the compiled program is the
+        deliverable here). Compiled programs are cached on the DAG shape
+        + tile placement + mesh, like the single-device path.
+        """
+        if not self.ops:
+            return
+        import jax
+        import numpy as np_mod
+        from jax.sharding import NamedSharding, PartitionSpec
+        from .dtd import WRITE
+
+        try:
+            axes = tuple(axis_names) if axis_names is not None \
+                else tuple(mesh.axis_names)
+            if len(axes) != 2:
+                output.fatal(f"execute_mesh needs a 2D mesh, got axes {axes}")
+
+            # classify tiles: collection-backed -> (dc, m, n); else local
+            colls: Dict[str, Any] = {}
+            placement: List[Tuple] = []    # ("c", name, m, n) | ("l", li)
+            local_vals: List[Any] = []
+            for t in self._tiles:
+                dc = t.dc
+                if dc is not None and hasattr(dc, "lnt") and hasattr(dc, "mb"):
+                    if dc.lm % dc.mb or dc.ln % dc.nb:
+                        output.fatal(f"execute_mesh: collection {dc.name} "
+                                     f"has partial edge tiles")
+                    colls.setdefault(dc.name, dc)
+                    m, n = divmod(t.key[1], dc.lnt)
+                    placement.append(("c", dc.name, m, n))
+                else:
+                    copy = t.data.newest_copy()
+                    if copy is None or copy.payload is None:
+                        output.fatal(f"execute_mesh: tile {t!r} has no data")
+                    placement.append(("l", len(local_vals)))
+                    local_vals.append(copy.payload)
+
+            mx, my = (mesh.devices.shape[mesh.axis_names.index(a)]
+                      for a in axes)
+            for dc in colls.values():
+                if dc.lm % mx or dc.ln % my:
+                    output.fatal(f"execute_mesh: {dc.name} {dc.lm}x{dc.ln} "
+                                 f"not divisible by mesh {mx}x{my}")
+        except Exception:
+            # a batch the mesh path rejected must not linger: close()/wait()
+            # would otherwise execute it single-device behind the
+            # caller's back
+            self.ops = []
+            self._tiles = []
+            self._tile_ix = {}
+            raise
+
+        coll_names = sorted(colls)
+        sh = NamedSharding(mesh, PartitionSpec(*axes))
+        globals_in = []
+        for name in coll_names:
+            dc = colls[name]
+            dense = np_mod.zeros((dc.lm, dc.ln), dtype=dc.dtype)
+            for m in range(dc.lmt):
+                for n in range(dc.lnt):
+                    if not dc.stored(m, n):
+                        continue
+                    c = dc.data_of(m, n).newest_copy()
+                    if c is not None and c.payload is not None:
+                        dense[m*dc.mb:(m+1)*dc.mb, n*dc.nb:(n+1)*dc.nb] = \
+                            np_mod.asarray(c.payload)
+            globals_in.append(jax.device_put(dense, sh))
+
+        ops = self.ops
+        coll_ix = {n: i for i, n in enumerate(coll_names)}
+        written_cols = sorted({placement[e[1]][1] for _, spec in ops
+                               for e in spec if e[0] == "flow"
+                               and e[2] & WRITE and placement[e[1]][0] == "c"})
+        written_locals = sorted({placement[e[1]][1] for _, spec in ops
+                                 for e in spec if e[0] == "flow"
+                                 and e[2] & WRITE and placement[e[1]][0] == "l"})
+        mbnb = {n: (colls[n].mb, colls[n].nb) for n in coll_names}
+        arr_vals = [e[1] for _, spec in ops for e in spec if e[0] == "array"]
+
+        def build_mesh_program():
+            def program(globs, locs, arrs):
+                globs = list(globs)
+                locs = list(locs)
+
+                def read(ti):
+                    kind = placement[ti]
+                    if kind[0] == "l":
+                        return locs[kind[1]]
+                    _, name, m, n = kind
+                    mb, nb = mbnb[name]
+                    return jax.lax.slice(globs[coll_ix[name]],
+                                         (m*mb, n*nb), ((m+1)*mb, (n+1)*nb))
+
+                def write(ti, v):
+                    kind = placement[ti]
+                    if kind[0] == "l":
+                        locs[kind[1]] = v
+                        return
+                    _, name, m, n = kind
+                    mb, nb = mbnb[name]
+                    gi = coll_ix[name]
+                    globs[gi] = jax.lax.dynamic_update_slice(
+                        globs[gi], v.astype(globs[gi].dtype), (m*mb, n*nb))
+
+                GraphCapture._replay(ops, read, write, arrs)
+                return (tuple(globs[coll_ix[n]] for n in written_cols),
+                        tuple(locs[i] for i in written_locals))
+
+            return jax.jit(
+                program,
+                in_shardings=(tuple(sh for _ in globals_in), None, None),
+                out_shardings=(tuple(sh for _ in written_cols), None))
+
+        # cache on DAG shape + tile placement + collection geometry + mesh:
+        # re-running the same distributed DAG skips trace and GSPMD compile
+        sig = ("mesh", self._signature(local_vals), tuple(placement),
+               tuple((n, colls[n].lm, colls[n].ln, *mbnb[n])
+                     for n in coll_names),
+               tuple(mesh.devices.shape), tuple(mesh.axis_names), axes,
+               tuple(d.id for d in mesh.devices.flat))
+        with _cache_lock:
+            jitted = _program_cache.get(sig)
+            self.cache_hit = jitted is not None
+            if jitted is None:
+                jitted = build_mesh_program()
+                _program_cache[sig] = jitted
+                while len(_program_cache) > _PROGRAM_CACHE_MAX:
+                    _program_cache.popitem(last=False)
+            else:
+                _program_cache.move_to_end(sig)
+        out_globs, out_locs = jitted(tuple(globals_in), tuple(local_vals),
+                                     tuple(arr_vals))
+
+        # scatter results back to tile copies (one host assembly per
+        # written collection in v1)
+        from ..data.data import COHERENCY_OWNED
+
+        def land(tile, val):
+            host = tile.data.get_copy(0)
+            if host is None:
+                tile.data.create_copy(0, val, COHERENCY_OWNED)
+            else:
+                host.payload = val
+            tile.data.bump_version(0)
+
+        dense_out = {n: np_mod.asarray(g)
+                     for n, g in zip(written_cols, out_globs)}
+        written_tiles = {e[1] for _, spec in ops for e in spec
+                         if e[0] == "flow" and e[2] & WRITE}
+        li = {v: i for i, v in enumerate(written_locals)}
+        for ti in sorted(written_tiles):
+            kind = placement[ti]
+            tile = self._tiles[ti]
+            if kind[0] == "l":
+                land(tile, out_locs[li[kind[1]]])
+            else:
+                _, name, m, n = kind
+                mb, nb = mbnb[name]
+                land(tile, dense_out[name][m*mb:(m+1)*mb, n*nb:(n+1)*nb])
+        self.executions += 1
         self.ops = []
         self._tiles = []
         self._tile_ix = {}

@@ -691,6 +691,16 @@ class DTDTaskpool(Taskpool):
         for t in tiles:
             self.data_flush(t)
 
+    def wait_mesh(self, mesh, axis_names=None) -> bool:
+        """Capture-mode only: execute the recorded DAG as ONE GSPMD program
+        over ``mesh`` — collection tiles become slices of sharded global
+        arrays, XLA partitions the work and inserts the ICI transfers
+        (see dsl/capture.py:execute_mesh)."""
+        if self._capture is None:
+            output.fatal("wait_mesh requires DTDTaskpool(capture=True)")
+        self._capture.execute_mesh(mesh, axis_names)
+        return True
+
     def wait(self, timeout: Optional[float] = None) -> bool:
         """parsec_dtd_taskpool_wait: drain everything this rank executes."""
         if self._capture is not None:

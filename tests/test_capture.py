@@ -244,3 +244,118 @@ END
                                np.asarray(C1.to_dense()), rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(C2.to_dense()), a @ b,
                                rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------- mesh capture
+
+def _mesh2d():
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("x", "y"))
+
+
+def test_mesh_capture_gemm(ctx):
+    """The whole tiled-GEMM DAG as ONE GSPMD program over a 2x4 mesh:
+    collection tiles become slices of sharded globals, XLA partitions the
+    ops and inserts the transfers; results match numpy."""
+    mesh = _mesh2d()
+    n, ts = 64, 16
+    rng = np.random.default_rng(21)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A, B, C = _gemm_collections("m", n, ts, a, b)
+    cap = DTDTaskpool(ctx, "mesh-gemm", capture=True)
+    insert_gemm_tasks(cap, A, B, C, batch_k=True)
+    cap.wait_mesh(mesh)
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(C.to_dense()), a @ b,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_mesh_capture_potrf_matches_single(ctx):
+    """Mesh capture on the factorization DAG (slices + update-slices with
+    serial dependencies) matches the single-device captured result."""
+    mesh = _mesh2d()
+    n, ts = 64, 16
+    spd = make_spd(n, seed=17)
+
+    P1 = TwoDimBlockCyclic("mp1", n, n, ts, ts, P=1, Q=1)
+    P1.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    cap1 = DTDTaskpool(ctx, "mp-single", capture=True)
+    insert_potrf_tasks(cap1, P1)
+    cap1.wait()
+    cap1.close()
+
+    P2 = TwoDimBlockCyclic("mp2", n, n, ts, ts, P=1, Q=1)
+    P2.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    cap2 = DTDTaskpool(ctx, "mp-mesh", capture=True)
+    insert_potrf_tasks(cap2, P2)
+    cap2.wait_mesh(mesh)
+    cap2.close()
+    ctx.wait(timeout=30)
+
+    got = np.tril(np.asarray(P2.to_dense(), np.float64))
+    ref = np.tril(np.asarray(P1.to_dense(), np.float64))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_mesh_capture_scratch_and_guards(ctx):
+    """Scratch tiles ride replicated; indivisible globals are rejected."""
+    mesh = _mesh2d()
+    cap = DTDTaskpool(ctx, "mesh-scratch", capture=True)
+    t = cap.tile_new((8, 8), np.float32)
+    t.data.create_copy(0, np.ones((8, 8), np.float32))
+    cap.insert_task(lambda x: x * 3.0, (t, RW))
+    cap.wait_mesh(mesh)
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 3.0)
+
+    bad = TwoDimBlockCyclic("meshbad", 10, 10, 5, 5, P=1, Q=1)  # 10 % 4 != 0
+    bad.fill(lambda m, n: np.zeros((5, 5), np.float32))
+    cap2 = DTDTaskpool(ctx, "mesh-bad", capture=True)
+    try:
+        cap2.insert_task(lambda x: x + 1.0, (cap2.tile_of(bad, 0, 0), RW))
+        with pytest.raises(RuntimeError, match="divisible"):
+            cap2.wait_mesh(mesh)
+        # the rejected batch is DISCARDED: close() must not silently run it
+        # single-device
+        assert cap2._capture.ops == []
+    finally:
+        cap2.close()
+    assert cap2._capture.executions == 0
+    np.testing.assert_allclose(
+        np.asarray(bad.data_of(0, 0).newest_copy().payload), 0.0)
+
+
+def test_mesh_capture_program_cache(ctx):
+    """Identical distributed DAG shapes over the same mesh reuse the
+    compiled GSPMD executable."""
+    mesh = _mesh2d()
+    n, ts = 32, 8
+    rng = np.random.default_rng(23)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A, B, C = _gemm_collections("mc", n, ts, a, b)
+    cap = DTDTaskpool(ctx, "mesh-cache", capture=True)
+    insert_gemm_tasks(cap, A, B, C, batch_k=True)
+    cap.wait_mesh(mesh)
+    assert not cap._capture.cache_hit
+    insert_gemm_tasks(cap, A, B, C, batch_k=True)
+    cap.wait_mesh(mesh)
+    assert cap._capture.cache_hit
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(C.to_dense()), 2 * (a @ b),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_wait_mesh_requires_capture(ctx):
+    tp = DTDTaskpool(ctx, "nomesh")
+    with pytest.raises(RuntimeError, match="capture"):
+        tp.wait_mesh(None)
+    tp.close()
