@@ -359,3 +359,31 @@ def test_wait_mesh_requires_capture(ctx):
     with pytest.raises(RuntimeError, match="capture"):
         tp.wait_mesh(None)
     tp.close()
+
+
+@pytest.mark.parametrize("which", ["getrf", "geqrf"])
+def test_capture_lu_qr_match_scheduler(ctx, which):
+    """Capture generality: the LU and QR tile DAGs (solves, householder
+    panels) compile whole and match the scheduler path."""
+    n, ts = 48, 16
+    if which == "getrf":
+        from parsec_tpu.ops.getrf import insert_getrf_tasks as ins, make_dd
+        src = make_dd(n, seed=3)
+    else:
+        from parsec_tpu.ops.geqrf import insert_geqrf_tasks as ins
+        rng = np.random.default_rng(3)
+        src = rng.standard_normal((n, n)).astype(np.float32)
+
+    def run(capture):
+        M = TwoDimBlockCyclic(f"{which}{capture}", n, n, ts, ts, P=1, Q=1)
+        M.fill(lambda m, k: src[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+        tp = DTDTaskpool(ctx, f"{which}-{capture}", capture=capture)
+        ins(tp, M)
+        tp.wait(timeout=60)
+        tp.close()
+        ctx.wait(timeout=30)
+        return np.asarray(M.to_dense(), np.float64)
+
+    sched = run(False)
+    cap = run(True)
+    np.testing.assert_allclose(cap, sched, rtol=1e-4, atol=1e-4)
