@@ -102,3 +102,69 @@ def make_spd(n: int, seed: int = 0, dtype=np.float32) -> np.ndarray:
     a = rng.standard_normal((n, n)).astype(np.float64) / np.sqrt(n)
     spd = a @ a.T + np.eye(n) * n * 0.05
     return spd.astype(dtype)
+
+
+# --------------------------------------------------------------- SPD solve
+
+def tile_trsv_l(lkk, bk):
+    """B[k] <- L(k,k)^{-1} B[k] (forward substitution step)."""
+    import jax
+    with jax.default_matmul_precision("highest"):
+        return jax.scipy.linalg.solve_triangular(lkk, bk, lower=True)
+
+
+def tile_trsv_lt(lkk, bk):
+    """B[k] <- L(k,k)^{-T} B[k] (backward substitution step)."""
+    import jax
+    with jax.default_matmul_precision("highest"):
+        return jax.scipy.linalg.solve_triangular(lkk, bk, lower=True,
+                                                 trans=1)
+
+
+def tile_gemv_sub(lmk, yk, bm):
+    """B[m] <- B[m] - L(m,k) Y[k]."""
+    import jax.numpy as jnp
+    from .pallas_kernels import dot_precision
+    return bm - jnp.dot(lmk, yk, precision=dot_precision(),
+                        preferred_element_type=jnp.float32).astype(bm.dtype)
+
+
+def tile_gemv_sub_t(lkm, xk, ym):
+    """Y[m] <- Y[m] - L(k,m)^T X[k]."""
+    import jax.numpy as jnp
+    from .pallas_kernels import dot_precision
+    return ym - jnp.dot(lkm.T, xk, precision=dot_precision(),
+                        preferred_element_type=jnp.float32).astype(ym.dtype)
+
+
+def insert_posv_tasks(tp: DTDTaskpool, A: TiledMatrix,
+                      B: TiledMatrix) -> int:
+    """Solve A X = B for SPD A (the DPLASMA dposv shape): Cholesky
+    factorization followed by tiled forward and backward substitution, one
+    taskpool — the solves chain onto the factorization through the tile
+    dependencies, so panels start solving while trailing updates still run.
+    B is a (T x 1)-tile right-hand-side collection, overwritten with X.
+    Works under both execution modes (scheduler and capture)."""
+    T = A.mt
+    assert A.mt == A.nt and B.mt == T and B.nt == 1
+    n0 = tp.inserted
+    insert_potrf_tasks(tp, A)
+    # forward: L Y = B
+    for k in range(T):
+        tp.insert_task(tile_trsv_l, (tp.tile_of(A, k, k), READ),
+                       (tp.tile_of(B, k, 0), RW | AFFINITY), name="TRSV_L")
+        for m in range(k + 1, T):
+            tp.insert_task(tile_gemv_sub, (tp.tile_of(A, m, k), READ),
+                           (tp.tile_of(B, k, 0), READ),
+                           (tp.tile_of(B, m, 0), RW | AFFINITY),
+                           name="GEMV_SUB")
+    # backward: L^T X = Y
+    for k in reversed(range(T)):
+        tp.insert_task(tile_trsv_lt, (tp.tile_of(A, k, k), READ),
+                       (tp.tile_of(B, k, 0), RW | AFFINITY), name="TRSV_LT")
+        for m in range(k):
+            tp.insert_task(tile_gemv_sub_t, (tp.tile_of(A, k, m), READ),
+                           (tp.tile_of(B, k, 0), READ),
+                           (tp.tile_of(B, m, 0), RW | AFFINITY),
+                           name="GEMV_SUB_T")
+    return tp.inserted - n0

@@ -366,3 +366,81 @@ def test_generalized_reduction_non_commutative_op():
     ref = functools.reduce(lambda a, b: a @ b, mats)
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
     ctx.fini()
+
+
+# ------------------------------------------------------------- SPD solve
+
+def test_posv_solver_both_modes():
+    """dposv shape: factorization + forward/backward substitution in one
+    taskpool, scheduler and capture modes, vs numpy solve."""
+    import parsec_tpu as pt
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    from parsec_tpu.ops.potrf import insert_posv_tasks, make_spd
+
+    n, ts, nrhs = 64, 16, 8
+    spd = make_spd(n, seed=12)
+    rng = np.random.default_rng(12)
+    rhs = rng.standard_normal((n, nrhs)).astype(np.float32)
+    ref = np.linalg.solve(spd.astype(np.float64), rhs.astype(np.float64))
+
+    ctx = pt.Context(nb_cores=1)
+    try:
+        for capture in (False, True):
+            A = TwoDimBlockCyclic(f"posvA{capture}", n, n, ts, ts, P=1, Q=1)
+            B = TwoDimBlockCyclic(f"posvB{capture}", n, nrhs, ts, nrhs,
+                                  P=1, Q=1)
+            A.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+            B.fill(lambda m, k: rhs[m*ts:(m+1)*ts, :])
+            tp = DTDTaskpool(ctx, f"posv{capture}", capture=capture)
+            cnt = insert_posv_tasks(tp, A, B)
+            assert cnt > 0
+            tp.wait(timeout=60)
+            tp.close()
+            ctx.wait(timeout=30)
+            got = np.asarray(B.to_dense(), np.float64)
+            np.testing.assert_allclose(got, ref, rtol=0, atol=5e-3)
+    finally:
+        ctx.fini()
+
+
+def test_posv_2rank():
+    """Distributed dposv across 2 ranks through the remote-dep protocol."""
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    from parsec_tpu.ops.potrf import insert_posv_tasks, make_spd
+
+    n, ts, nrhs = 64, 16, 4
+    spd = make_spd(n, seed=8)
+    rng = np.random.default_rng(8)
+    rhs = rng.standard_normal((n, nrhs)).astype(np.float32)
+
+    def program(rank, fabric):
+        ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        kw = dict(nodes=2, myrank=rank)
+        A = TwoDimBlockCyclic("pvA", n, n, ts, ts, P=2, Q=1, **kw)
+        B = TwoDimBlockCyclic("pvB", n, nrhs, ts, nrhs, P=2, Q=1, **kw)
+        A.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+        B.fill(lambda m, k: rhs[m*ts:(m+1)*ts, :])
+        tp = DTDTaskpool(ctx, "posv2")
+        insert_posv_tasks(tp, A, B)
+        tp.wait(timeout=90)
+        tp.close()
+        ctx.wait(timeout=60)
+        ctx.fini()
+        return {m: np.asarray(B.data_of(m, 0).newest_copy().payload)
+                for m in range(B.mt) if B.rank_of(m, 0) == rank}
+
+    results = run_distributed(2, program, timeout=150)
+    ref = np.linalg.solve(spd.astype(np.float64), rhs.astype(np.float64))
+    seen = {}
+    for out in results:
+        seen.update(out)
+    assert len(seen) == n // ts
+    for m, x in seen.items():
+        np.testing.assert_allclose(x.astype(np.float64),
+                                   ref[m*ts:(m+1)*ts, :], rtol=0, atol=5e-3)

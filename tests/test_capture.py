@@ -387,3 +387,28 @@ def test_capture_lu_qr_match_scheduler(ctx, which):
     sched = run(False)
     cap = run(True)
     np.testing.assert_allclose(cap, sched, rtol=1e-4, atol=1e-4)
+
+
+def test_capture_stencil_matches_scheduler(ctx):
+    """The iterative halo-exchange DAG (BASELINE config 4's 1D shape)
+    compiles whole: ping-pong buffers and neighbor reads trace through."""
+    from parsec_tpu.data.matrix import TiledMatrix
+    from parsec_tpu.ops.stencil import insert_stencil1d_tasks
+
+    cols, ts, iters = 64, 16, 4
+    rng = np.random.default_rng(2)
+    init = rng.standard_normal((8, cols)).astype(np.float32)
+
+    def run(capture):
+        A = TiledMatrix(f"stA{capture}", 8, cols, 8, ts)
+        B = TiledMatrix(f"stB{capture}", 8, cols, 8, ts)
+        A.fill(lambda m, n: init[:, n*ts:(n+1)*ts])
+        B.fill(lambda m, n: np.zeros((8, ts), np.float32))
+        tp = DTDTaskpool(ctx, f"st{capture}", capture=capture)
+        insert_stencil1d_tasks(tp, A, B, iters)
+        tp.wait(timeout=60)
+        tp.close()
+        ctx.wait(timeout=30)
+        return np.asarray(A.to_dense())     # iters even -> result in A
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6, atol=1e-6)
