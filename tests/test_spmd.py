@@ -72,3 +72,93 @@ def test_training_step_composite():
     C2, L = spmd.training_step(A, B, C, mesh)
     np.testing.assert_allclose(np.asarray(C2), A @ B, rtol=1e-4, atol=1e-4)
     assert not np.isnan(np.asarray(L)).any()
+
+
+# ------------------------------------------------------- mesh data bridge
+
+def test_mesh_bridge_roundtrip_and_spmd_handoff():
+    """Task-world matrices hand off to SPMD programs and back: a DTD GEMM
+    writes C, to_global shards it over the mesh, a jitted sharded program
+    transforms it, from_global makes the result visible to a second
+    taskpool."""
+    import parsec_tpu as pt
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.data.mesh_bridge import from_global, to_global
+    from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+    from parsec_tpu.ops.gemm import insert_gemm_tasks
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.array(devs[:8]).reshape(2, 4), ("x", "y"))
+
+    n, ts = 64, 16
+    rng = np.random.default_rng(31)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    ctx = pt.Context(nb_cores=1)
+    try:
+        A = TwoDimBlockCyclic("mbA", n, n, ts, ts, P=1, Q=1)
+        B = TwoDimBlockCyclic("mbB", n, n, ts, ts, P=1, Q=1)
+        C = TwoDimBlockCyclic("mbC", n, n, ts, ts, P=1, Q=1)
+        A.fill(lambda m, k: a[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+        B.fill(lambda m, k: b[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+        C.fill(lambda m, k: np.zeros((ts, ts), np.float32))
+        tp = DTDTaskpool(ctx, "bridge-gemm")
+        insert_gemm_tasks(tp, A, B, C)
+        tp.wait(timeout=60)
+        tp.close()
+        ctx.wait(timeout=30)
+
+        # task world -> SPMD world
+        g = to_global(C, mesh)
+        assert g.sharding == NamedSharding(mesh, PartitionSpec("x", "y"))
+        sh = g.sharding
+        scale = jax.jit(lambda x: 2.0 * x, in_shardings=sh, out_shardings=sh)
+        g2 = scale(g)
+
+        # SPMD world -> task world: a second taskpool sees the result
+        from_global(C, g2)
+        tp2 = DTDTaskpool(ctx, "bridge-post")
+        for m in range(C.mt):
+            tp2.insert_task(lambda x: x + 1.0, (tp2.tile_of(C, m, 0), RW))
+        tp2.wait(timeout=30)
+        tp2.close()
+        ctx.wait(timeout=30)
+
+        got = np.asarray(C.to_dense())
+        expect = 2.0 * (a @ b)
+        expect[:, :ts] += 1.0          # the second pool touched column 0
+        np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-3)
+    finally:
+        ctx.fini()
+
+
+def test_mesh_bridge_redistribute():
+    """Layout change through the resharding seam: 16x16 tiles on a 2x2
+    grid -> 8x8 tiles single-grid, values preserved (the XLA-planned
+    redistribution; host redistribute.py remains the cross-rank variant)."""
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.data.mesh_bridge import redistribute_mesh
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.array(devs[:8]).reshape(2, 4), ("x", "y"))
+
+    n = 64
+    rng = np.random.default_rng(33)
+    src_v = rng.standard_normal((n, n)).astype(np.float32)
+    src = TwoDimBlockCyclic("rsrc", n, n, 16, 16, P=1, Q=1)
+    dst = TwoDimBlockCyclic("rdst", n, n, 8, 8, P=1, Q=1)
+    src.fill(lambda m, k: src_v[m*16:(m+1)*16, k*16:(k+1)*16])
+    redistribute_mesh(src, dst, mesh)
+    np.testing.assert_allclose(np.asarray(dst.to_dense()), src_v,
+                               rtol=0, atol=0)
+
+    import pytest as _pytest
+    bad = TwoDimBlockCyclic("rbad", 32, 32, 8, 8, P=1, Q=1)
+    with _pytest.raises(RuntimeError, match="extents differ"):
+        redistribute_mesh(src, bad, mesh)
