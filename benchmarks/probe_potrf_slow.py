@@ -1,0 +1,149 @@
+"""On-chip bisect: why is the captured POTRF DAG ~50x slower than its op sum?
+
+Experiments (all slope-timed with a precompiled scalar-fetch barrier):
+  E1  scan-chol:    one cholesky(1024) instance iterated k times in lax.scan
+  E2  inline-chol:  k chained cholesky(1024) instances inlined in one jit
+  E3  captured DAG variants with one body class swapped for a cheap op, to
+      locate the slow component (chol / trsm / syrk+gemm).
+
+Run manually on the live chip: python benchmarks/probe_potrf_slow.py
+"""
+import time
+import functools as ft
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+fetch = jax.jit(lambda x: x[:1, :1].astype(jnp.float32))
+
+
+def force(x):
+    return np.asarray(jax.device_get(fetch(x)))
+
+
+def timed(f, reps=3):
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f()
+        out.append(time.perf_counter() - t0)
+    return min(out)
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    TS = 1024
+    rng = np.random.default_rng(0)
+    spd1 = (lambda a: (a @ a.T / TS + np.eye(TS) * 4).astype(np.float32))(
+        rng.standard_normal((TS, TS)))
+    x0 = jax.device_put(spd1)
+
+    def resym(l, x):
+        # keep iterates SPD-ish and data-dependent (no hoisting/DCE)
+        return x + 1e-6 * jnp.tril(l) @ jnp.tril(l).T
+
+    @ft.partial(jax.jit, static_argnums=1)
+    def scan_chol(x, k):
+        with jax.default_matmul_precision("highest"):
+            def step(x, _):
+                return resym(jnp.linalg.cholesky(x), x), None
+            out, _ = jax.lax.scan(step, x, None, length=k)
+        return out
+
+    @ft.partial(jax.jit, static_argnums=1)
+    def inline_chol(x, k):
+        with jax.default_matmul_precision("highest"):
+            for _ in range(k):
+                x = resym(jnp.linalg.cholesky(x), x)
+        return x
+
+    for name, fn in (("E1 scan-chol", scan_chol), ("E2 inline-chol",
+                                                   inline_chol)):
+        t_compile = time.perf_counter()
+        for k in (2, 6):
+            force(fn(x0, k))
+        t_compile = time.perf_counter() - t_compile
+        t2 = timed(lambda: force(fn(x0, 2)))
+        t6 = timed(lambda: force(fn(x0, 6)))
+        print(f"{name}: compile+warm {t_compile:.1f}s  T2={t2*1e3:.1f}ms "
+              f"T6={t6*1e3:.1f}ms  slope={(t6-t2)/4*1e3:.2f} ms/chol",
+              flush=True)
+
+    # ---- E3: captured POTRF with selectively cheapened bodies -------------
+    import parsec_tpu as pt
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW, AFFINITY
+    from parsec_tpu.ops import potrf as P
+
+    pN, pTS = 4096, 1024
+    spd = P.make_spd(pN, seed=7)
+    ctx = pt.Context(nb_cores=1)
+    Pm = TwoDimBlockCyclic("Pprobe", pN, pN, pTS, pTS, P=1, Q=1)
+    pmt = pN // pTS
+    fuse = jax.jit(lambda ts: sum(t[0, 0].astype(jnp.float32) for t in ts))
+
+    def barrier():
+        s = fuse([jnp.asarray(Pm.data_of(m, k).newest_copy().payload)
+                  for m in range(pmt) for k in range(m + 1)])
+        np.asarray(jax.device_get(s))
+
+    def cheap1(a):
+        return a * 0.5
+
+    def cheap2(a, b):
+        return b - a * 1e-6
+
+    def cheap3(a, b, c):
+        return c - (a * 1e-6 + b * 1e-6)
+
+    variants = {
+        "full": (P.tile_potrf, P.tile_trsm, P.tile_syrk, P.tile_gemm_update),
+        "no-chol": (cheap1, P.tile_trsm, P.tile_syrk, P.tile_gemm_update),
+        "no-trsm": (P.tile_potrf, cheap2, P.tile_syrk, P.tile_gemm_update),
+        "no-syrk/gemm": (P.tile_potrf, P.tile_trsm, cheap2, cheap3),
+        "all-cheap": (cheap1, cheap2, cheap2, cheap3),
+    }
+
+    def insert(tp, fns):
+        fp, ft_, fs, fg = fns
+        T = Pm.mt
+        for k in range(T):
+            tp.insert_task(fp, (tp.tile_of(Pm, k, k), RW), name="POTRF")
+            for m in range(k + 1, T):
+                tp.insert_task(ft_, (tp.tile_of(Pm, k, k), READ),
+                               (tp.tile_of(Pm, m, k), RW), name="TRSM")
+            for m in range(k + 1, T):
+                tp.insert_task(fs, (tp.tile_of(Pm, m, k), READ),
+                               (tp.tile_of(Pm, m, m), RW), name="SYRK")
+                for n in range(k + 1, m):
+                    tp.insert_task(fg, (tp.tile_of(Pm, m, k), READ),
+                                   (tp.tile_of(Pm, n, k), READ),
+                                   (tp.tile_of(Pm, m, n), RW), name="GEMM")
+
+    for name, fns in variants.items():
+        Pm.fill(lambda m, k: spd[m*pTS:(m+1)*pTS, k*pTS:(k+1)*pTS])
+
+        def run(n_dags):
+            tp = DTDTaskpool(ctx, f"cap-{name}", capture=True)
+            t0 = time.perf_counter()
+            for _ in range(n_dags):
+                insert(tp, fns)
+                tp.wait()
+            tp.close()
+            barrier()
+            return time.perf_counter() - t0
+
+        tc = time.perf_counter()
+        run(1)
+        tc = time.perf_counter() - tc
+        t1 = timed(lambda: run(1), reps=2)
+        t3 = timed(lambda: run(3), reps=2)
+        print(f"E3 {name:14s}: compile {tc:5.1f}s  T1={t1*1e3:7.1f}ms "
+              f"T3={t3*1e3:7.1f}ms  slope={(t3-t1)/2*1e3:7.1f} ms/DAG",
+              flush=True)
+    ctx.fini()
+
+
+if __name__ == "__main__":
+    main()

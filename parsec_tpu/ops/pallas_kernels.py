@@ -12,6 +12,12 @@ chore path:
   VMEM accumulation, for large single dots.
 * :func:`stencil1d` — fused 3-point stencil with halo columns (one VPU pass,
   no intermediate materialization).
+* :func:`flash_attention` — blockwise attention with the online-softmax
+  accumulation fused into one kernel: scores, running max/sum and the
+  weighted-V accumulation never leave VMEM (the HBM-bandwidth win that
+  motivates flash attention), grid over (batch·heads, query blocks), k/v
+  resident per head. Positional offsets make it usable on rotated ring
+  blocks (`parallel/ring_attention.py`) and sequence-sharded shards.
 
 Every entry point degrades gracefully: on non-TPU backends the kernels run
 in interpreter mode (tests), and any Pallas failure falls back to the XLA
@@ -112,6 +118,13 @@ def verify_lowering(shapes=((256, 256, 256), ), kt: int = 4) -> dict:
                 (jax.ShapeDtypeStruct((8, n), f32),
                  jax.ShapeDtypeStruct((8, n), f32),
                  jax.ShapeDtypeStruct((8, n), f32))),
+            "flash_attention[2x256x128]": (
+                lambda: _flash_attn_call(
+                    2, 256, 256, 128, 128, 128, True, 0.088388,
+                    0, 0, "float32", interp, None),
+                (jax.ShapeDtypeStruct((2, 256, 128), f32),
+                 jax.ShapeDtypeStruct((2, 256, 128), f32),
+                 jax.ShapeDtypeStruct((2, 256, 128), f32))),
         }
         for name, (build, args) in checks.items():
             try:
@@ -284,3 +297,153 @@ def stencil1d(x, left, right, weights=(0.25, 0.5, 0.25)):
         xm = jnp.concatenate([left[:, -1:], x[:, :-1]], axis=1)
         xp = jnp.concatenate([x[:, 1:], right[:, :1]], axis=1)
         return (w0 * xm + w1 * x + w2 * xp).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _flash_attn_call(bh: int, sq: int, sk: int, d: int, bq: int, bk: int,
+                     causal: bool, scale: float, q_off: int, k_off: int,
+                     dtype: str, interpret: bool, vma=None):
+    """Grid (bh, sq//bq, sk//bk): k/v STREAM through VMEM one block per
+    step (so sequence length is HBM-bounded, not VMEM-bounded) while the
+    online-softmax state (running max ``m``, rescaled sum ``l``,
+    accumulator ``acc``) lives in VMEM scratch across the k dimension —
+    scores and probabilities are never written to HBM.
+
+    ``q_off``/``k_off`` are the GLOBAL positions of row/col 0, so the
+    causal mask is correct on sequence shards and rotated ring blocks;
+    fully-masked rows produce ZERO output (ring-fold convention).
+    ``vma`` types the output as varying over those mesh axes so the kernel
+    can sit inside a ``shard_map`` with the VMA checker on."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nk = sk // bk
+    neg = -1e30
+
+    def kernel(q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref):
+        iq = pl.program_id(1)
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, neg)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        # blocks entirely above the causal diagonal contribute nothing
+        intersects = True
+        if causal:
+            intersects = (k_off + kk * bk) <= (q_off + (iq + 1) * bq - 1)
+
+        @pl.when(intersects)
+        def _():
+            q = q_ref[0].astype(jnp.float32) * scale      # (bq, d)
+            kb = k_ref[0].astype(jnp.float32)             # (bk, d)
+            vb = v_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                k_pos = k_off + kk * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                s = jnp.where(k_pos <= q_pos, s, neg)
+            m = jnp.max(m_ref[...], axis=1, keepdims=True)   # lanes equal
+            l = jnp.max(l_ref[...], axis=1, keepdims=True)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            # a masked score must carry ZERO weight even when the whole
+            # row is masked (s == m_new == neg would give p = 1)
+            p = jnp.where(s > 0.5 * neg, p, 0.0)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[...] * corr + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(l, l_ref.shape)
+
+        @pl.when(kk == nk - 1)
+        def _():
+            l = jnp.max(l_ref[...], axis=1, keepdims=True)
+            out_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)
+                          ).astype(out_ref.dtype)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(bh, sq // bq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, iq, kk: (b, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, kk: (b, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, kk: (b, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, iq, kk: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), dtype,
+                                       vma=set(vma) if vma else None),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max (lanes equal)
+            pltpu.VMEM((bq, 128), jnp.float32),   # running sum (lanes equal)
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float = None,
+                    q_offset: int = 0, k_offset: int = 0,
+                    block_q: int = 256, block_k: int = 512, vma=None):
+    """Fused softmax(q·kᵀ·scale)·v over (..., seq, head_dim) operands.
+
+    Accepts (B, H, S, D) or (BH, S, D); k/v may have a different sequence
+    length than q (cross-attention, ring blocks, sequence shards —
+    ``q_offset``/``k_offset`` give the global position of element 0 so the
+    causal mask stays correct; fully-masked rows return zeros). Inside a
+    ``shard_map``, pass ``vma=(axis, ...)`` so the output is typed as
+    device-varying. Falls back to the XLA expression of the same math on
+    any Pallas failure raised at trace/call time — a Mosaic error
+    surfacing later, at an OUTER jit's compile, is out of reach by design;
+    :func:`verify_lowering` is the gate for that class."""
+    import jax.numpy as jnp
+    q4 = q.reshape((-1,) + q.shape[-2:])
+    k4 = k.reshape((-1,) + k.shape[-2:])
+    v4 = v.reshape((-1,) + v.shape[-2:])
+    bhn, sq, d = q4.shape
+    sk = k4.shape[1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    try:
+        if sq % bq or sk % bk:
+            raise ValueError(f"seq lengths ({sq}, {sk}) not divisible by "
+                             f"blocks ({bq}, {bk})")
+        out = _flash_attn_call(bhn, sq, sk, d, bq, bk, bool(causal),
+                               float(scale), int(q_offset), int(k_offset),
+                               str(q.dtype), _interpret(),
+                               tuple(vma) if vma else None)(q4, k4, v4)
+    except Exception as e:  # noqa: BLE001
+        _fallback("flash_attention", e)
+        import jax
+        s = jnp.einsum("bqd,bkd->bqk", q4.astype(jnp.float32),
+                       k4.astype(jnp.float32),
+                       precision=jax.lax.Precision.DEFAULT) * scale
+        if causal:
+            qp = q_offset + jnp.arange(sq)[:, None]
+            kp = k_offset + jnp.arange(sk)[None, :]
+            s = jnp.where(kp <= qp, s, -jnp.inf)
+        # explicit guarded softmax: fully-masked rows give ZERO output
+        # (jax.nn.softmax would return uniform weights there)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - jnp.where(
+            jnp.isfinite(m), m, 0.0)), 0.0)
+        l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+        out = jnp.einsum("bqk,bkd->bqd", p / l, v4.astype(jnp.float32)
+                         ).astype(q.dtype)
+    return out.reshape(q.shape)

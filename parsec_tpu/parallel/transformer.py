@@ -66,6 +66,16 @@ def _dense_attention_core(q, k, v, causal: bool, scale: float):
     return jnp.einsum("bhqk,bhkd->bhqd", a, v)
 
 
+def flash_attention_core(q, k, v, causal: bool, scale: float):
+    """Drop-in ``attention=`` core backed by the fused Pallas kernel
+    (:func:`parsec_tpu.ops.pallas_kernels.flash_attention`): scores and
+    softmax stats stay in VMEM instead of materializing the S x S matrix.
+    Best on single-chip / data-parallel layouts where the sequence axis is
+    unsharded; under GSPMD head-sharding wrap it in shard_map first."""
+    from ..ops.pallas_kernels import flash_attention
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
 def block_apply(params, x, causal: bool = True, attention=None):
     """One pre-LN transformer block: x -> x + MHA(LN(x)) -> + MLP(LN(.)).
 

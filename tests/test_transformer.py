@@ -128,3 +128,18 @@ def test_sp_training_reduces_loss_long_seq():
         p, loss = step(p, xd, yd)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.95, losses
+
+
+def test_flash_attention_core_matches_dense():
+    """The Pallas flash core is a drop-in for the dense attention core."""
+    from parsec_tpu.parallel.transformer import (
+        _dense_attention_core, block_apply, flash_attention_core,
+        init_block_params)
+    rng = np.random.default_rng(9)
+    params = init_block_params(3, d_model=64, d_ff=128, n_heads=2)
+    x = rng.standard_normal((2, 64, 64)).astype(np.float32)
+    ref = np.asarray(block_apply(params, x, causal=True,
+                                 attention=_dense_attention_core))
+    out = np.asarray(block_apply(params, x, causal=True,
+                                 attention=flash_attention_core))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
