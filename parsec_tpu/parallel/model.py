@@ -1,0 +1,156 @@
+"""GPT-class causal language model: the flagship model family, assembled
+from the parallel building blocks.
+
+The reference is a task runtime, not a model zoo — this module is the
+"what you train WITH the framework" layer (SURVEY §2.8 beyond-reference
+rows): a complete decoder-only LM (learned token + position embeddings,
+N pre-LN transformer blocks, final LN, tied LM head) with
+
+* :func:`lm_apply` / :func:`lm_loss` — pure jax forward + token
+  cross-entropy, pluggable attention core (dense, Pallas flash, ring);
+* :func:`make_lm_train_step` — ONE compiled GSPMD step over a (dp, tp)
+  mesh: batch over ``dp``; Megatron column/row-parallel block weights and
+  vocab-parallel embedding/head over ``tp``. The sharding annotations are
+  the whole distribution story — XLA inserts the dp grad all-reduces and
+  the tp activation collectives (scaling-book recipe, like
+  :func:`parsec_tpu.parallel.transformer.make_train_step`).
+
+Sequence parallelism for long contexts: pass
+``attention=ring_core(mesh)`` (see :func:`ring_attention_core`) and shard
+the tokens' sequence axis instead — the blocks are token-local outside
+attention, so the same forward runs under either layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import numpy as np
+
+from .transformer import (block_apply, init_block_params, _ln, _param_spec,
+                          ring_attention_core)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Decoder-only LM hyperparameters (frozen: usable as a cache key)."""
+    vocab_size: int = 256
+    d_model: int = 128
+    d_ff: int = 512
+    n_heads: int = 8
+    n_layers: int = 2
+    max_seq: int = 256
+
+
+def init_lm_params(seed: int, cfg: ModelConfig) -> dict:
+    """Embeddings + per-block params + final LN. The LM head is TIED to
+    the token embedding (logits = h @ embed.T), the standard
+    weight-sharing that also halves the largest tensor."""
+    rng = np.random.default_rng(seed)
+    f32 = np.float32
+    p = {
+        "embed": (rng.standard_normal((cfg.vocab_size, cfg.d_model)) *
+                  0.02).astype(f32),
+        "pos": (rng.standard_normal((cfg.max_seq, cfg.d_model)) *
+                0.02).astype(f32),
+        "lnf_g": np.ones(cfg.d_model, f32),
+        "lnf_b": np.zeros(cfg.d_model, f32),
+        "blocks": [init_block_params(seed + 1 + i, cfg.d_model, cfg.d_ff,
+                                     cfg.n_heads)
+                   for i in range(cfg.n_layers)],
+    }
+    return p
+
+
+def lm_apply(params: dict, tokens, causal: bool = True, attention=None):
+    """tokens (B, S) int32 -> logits (B, S, V)."""
+    import jax.numpy as jnp
+    S = tokens.shape[1]
+    h = params["embed"][tokens] + params["pos"][:S][None, :, :]
+    for bp in params["blocks"]:
+        h = block_apply(bp, h, causal=causal, attention=attention)
+    h = _ln(h, params["lnf_g"], params["lnf_b"])
+    return jnp.einsum("bsd,vd->bsv", h, params["embed"])
+
+
+def lm_loss(params: dict, tokens, targets, causal: bool = True,
+            attention=None):
+    """Mean next-token cross-entropy; ``targets`` (B, S) int32."""
+    import jax
+    import jax.numpy as jnp
+    logits = lm_apply(params, tokens, causal=causal, attention=attention)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None],
+                               axis=-1).squeeze(-1)
+    return jnp.mean(logz - gold)
+
+
+
+
+def _lm_param_spec(mesh, dp: str, tp: str, n_layers: int):
+    """Vocab-parallel embedding/head over ``tp``; Megatron block specs."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = {
+        "embed": NamedSharding(mesh, P(tp, None)),   # vocab-parallel
+        "pos": NamedSharding(mesh, P()),
+        "lnf_g": NamedSharding(mesh, P()),
+        "lnf_b": NamedSharding(mesh, P()),
+        "blocks": [_param_spec(mesh, dp, tp) for _ in range(n_layers)],
+    }
+    return spec
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_lm_step(mesh, dp: str, tp: str, n_layers: int, lr: float,
+                      causal: bool):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pspec = _lm_param_spec(mesh, dp, tp, n_layers)
+    tsh = NamedSharding(mesh, P(dp, None))           # tokens (B, S)
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, tokens, targets, causal=causal))(params)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        return new_params, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(pspec, tsh, tsh),
+        out_shardings=(pspec, NamedSharding(mesh, P())),
+    ), pspec, tsh
+
+
+def make_lm_train_step(mesh, dp: str = "dp", tp: str = "tp",
+                       lr: float = 1e-2, causal: bool = True,
+                       n_layers: Optional[int] = None, params: dict = None):
+    """A jitted SGD LM training step over the (dp, tp) mesh.
+
+    Returns ``(step, place_params, place_batch)``; ``n_layers`` is taken
+    from ``params`` when given. Usage::
+
+        cfg = ModelConfig(n_layers=4)
+        params = init_lm_params(0, cfg)
+        step, place_p, place_t = make_lm_train_step(mesh, params=params)
+        params = place_p(params)
+        params, loss = step(params, place_t(tokens), place_t(targets))
+    """
+    import jax
+    if n_layers is None:
+        if params is None:
+            raise ValueError("pass n_layers= or params=")
+        n_layers = len(params["blocks"])
+    fn, pspec, tsh = _compiled_lm_step(mesh, dp, tp, int(n_layers),
+                                       float(lr), causal)
+
+    def place_params(p):
+        return jax.tree_util.tree_map(jax.device_put, p, pspec)
+
+    def place_batch(t):
+        return jax.device_put(t, tsh)
+
+    return fn, place_params, place_batch

@@ -161,18 +161,25 @@ def make_train_step(mesh, dp: str = "dp", tp: str = "tp",
     return fn, place_params, place_batch
 
 
+def ring_attention_core(mesh):
+    """An ``attention=`` core running ring attention over ``mesh`` (the
+    long-context layout: sequence axis sharded, K/V rotating over ICI)."""
+    from .ring_attention import ring_attention
+
+    def core(q, k, v, causal, scale):
+        return ring_attention(q, k, v, mesh=mesh, causal=causal,
+                              scale=scale)
+    return core
+
+
 def block_apply_sp(params, x, mesh, causal: bool = True):
     """The same pre-LN block with the SEQUENCE axis sharded over ``mesh``:
     attention runs as ring attention (ppermute K/V rotation, online
     softmax — :mod:`parsec_tpu.parallel.ring_attention`), the LN/MLP parts
     are token-local so GSPMD keeps them sharded for free. Fully
     differentiable: the ring's transpose is the reverse ring."""
-    from .ring_attention import ring_attention
-
-    def ring_core(q, k, v, causal, scale):
-        return ring_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
-
-    return block_apply(params, x, causal=causal, attention=ring_core)
+    return block_apply(params, x, causal=causal,
+                       attention=ring_attention_core(mesh))
 
 
 @functools.lru_cache(maxsize=None)
