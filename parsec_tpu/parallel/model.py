@@ -30,7 +30,7 @@ from typing import Optional
 import numpy as np
 
 from .transformer import (block_apply, init_block_params, _ln, _param_spec,
-                          ring_attention_core)
+                          _placers, ring_attention_core)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +68,9 @@ def lm_apply(params: dict, tokens, causal: bool = True, attention=None):
     """tokens (B, S) int32 -> logits (B, S, V)."""
     import jax.numpy as jnp
     S = tokens.shape[1]
+    if S > params["pos"].shape[0]:
+        raise ValueError(f"sequence length {S} exceeds the model's "
+                         f"max_seq {params['pos'].shape[0]}")
     h = params["embed"][tokens] + params["pos"][:S][None, :, :]
     for bp in params["blocks"]:
         h = block_apply(bp, h, causal=causal, attention=attention)
@@ -131,7 +134,8 @@ def make_lm_train_step(mesh, dp: str = "dp", tp: str = "tp",
     """A jitted SGD LM training step over the (dp, tp) mesh.
 
     Returns ``(step, place_params, place_batch)``; ``n_layers`` is taken
-    from ``params`` when given. Usage::
+    from ``params`` when given. For a real optimizer (Adam, schedules,
+    clipping) use :func:`make_lm_opt_train_step`. Usage::
 
         cfg = ModelConfig(n_layers=4)
         params = init_lm_params(0, cfg)
@@ -139,18 +143,81 @@ def make_lm_train_step(mesh, dp: str = "dp", tp: str = "tp",
         params = place_p(params)
         params, loss = step(params, place_t(tokens), place_t(targets))
     """
-    import jax
     if n_layers is None:
         if params is None:
             raise ValueError("pass n_layers= or params=")
         n_layers = len(params["blocks"])
     fn, pspec, tsh = _compiled_lm_step(mesh, dp, tp, int(n_layers),
                                        float(lr), causal)
+    return (fn,) + _placers(pspec, tsh)
 
-    def place_params(p):
-        return jax.tree_util.tree_map(jax.device_put, p, pspec)
 
-    def place_batch(t):
-        return jax.device_put(t, tsh)
+def _state_spec_like(mesh, pspec, params, state):
+    """Shardings for an optimizer-state pytree: optax moment trees MIRROR
+    the param tree, so a state leaf whose tree path ends with a
+    parameter's full path (and matches its shape) adopts that parameter's
+    sharding — Adam's mu/nu land distributed exactly like their params.
+    Everything else (counters, scalars) replicates. Path matching (not
+    shape matching) keeps equal-shaped params with different specs apart
+    (e.g. vocab-parallel ``embed`` vs replicated ``pos`` when
+    vocab_size == max_seq)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    by_path = {}
+    for (path, leaf), spec in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_leaves(pspec)):
+        by_path[tuple(map(str, path))] = (tuple(np.shape(leaf)), spec)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    out = []
+    for path, leaf in flat:
+        keys = tuple(map(str, path))
+        spec = rep
+        for i in range(len(keys)):
+            hit = by_path.get(keys[i:])
+            if hit is not None and hit[0] == tuple(np.shape(leaf)):
+                spec = hit[1]
+                break
+        out.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
-    return fn, place_params, place_batch
+
+def make_lm_opt_train_step(mesh, tx, params: dict, dp: str = "dp",
+                           tp: str = "tp", causal: bool = True):
+    """An optax-powered LM training step over the (dp, tp) mesh.
+
+    ``tx`` is any ``optax.GradientTransformation`` (e.g.
+    ``optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(sched))``).
+    Optimizer moments are sharded LIKE the parameters they mirror (see
+    :func:`_state_spec_like`). Returns
+    ``(step, opt_state, place_params, place_batch)``::
+
+        step, opt_state, place_p, place_t = make_lm_opt_train_step(
+            mesh, optax.adamw(3e-4), params)
+        params = place_p(params)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_layers = len(params["blocks"])
+    pspec = _lm_param_spec(mesh, dp, tp, n_layers)
+    tsh = NamedSharding(mesh, P(dp, None))
+    opt_state = tx.init(params)
+    ospec = _state_spec_like(mesh, pspec, params, opt_state)
+    opt_state = jax.tree_util.tree_map(jax.device_put, opt_state, ospec)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, tokens, targets, causal=causal))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    fn = jax.jit(
+        step,
+        in_shardings=(pspec, ospec, tsh, tsh),
+        out_shardings=(pspec, ospec, NamedSharding(mesh, P())),
+    )
+    return (fn, opt_state) + _placers(pspec, tsh)

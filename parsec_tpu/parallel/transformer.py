@@ -139,6 +139,20 @@ def _compiled_step(mesh, dp: str, tp: str, lr: float, causal: bool):
     ), pspec, xsh
 
 
+def _placers(pspec, xsh):
+    """(place_params, place_batch) pair for a (param-spec-tree, batch
+    sharding): the one placement idiom every make_*_train_step shares."""
+    import jax
+
+    def place_params(params):
+        return jax.tree_util.tree_map(jax.device_put, params, pspec)
+
+    def place_batch(x):
+        return jax.device_put(x, xsh)
+
+    return place_params, place_batch
+
+
 def make_train_step(mesh, dp: str = "dp", tp: str = "tp",
                     lr: float = 1e-2, causal: bool = True):
     """A jitted SGD training step over the (dp, tp) mesh.
@@ -149,16 +163,8 @@ def make_train_step(mesh, dp: str = "dp", tp: str = "tp",
     dp grad all-reduces and tp activation collectives from the sharding
     annotations alone.
     """
-    import jax
     fn, pspec, xsh = _compiled_step(mesh, dp, tp, float(lr), causal)
-
-    def place_params(params):
-        return {k: jax.device_put(v, pspec[k]) for k, v in params.items()}
-
-    def place_batch(x):
-        return jax.device_put(x, xsh)
-
-    return fn, place_params, place_batch
+    return (fn,) + _placers(pspec, xsh)
 
 
 def ring_attention_core(mesh):
