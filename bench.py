@@ -251,7 +251,9 @@ def main() -> None:
             return out
 
     spd_dev = jax.device_put(spd, devs[0])
-    ck_lo, ck_hi = (1, 3)
+    # long chains: one cholesky is ~3ms on-chip, far below relay jitter, so
+    # the slope needs >= 8 chol-lengths of separation to be trustworthy
+    ck_lo, ck_hi = (2, 10) if on_tpu else (1, 3)
     for k in (ck_lo, ck_hi):
         force(_chol_chain(spd_dev, k))
     t_lo = min(_timeit(lambda: force(_chol_chain(spd_dev, ck_lo)))

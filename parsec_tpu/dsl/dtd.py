@@ -50,6 +50,12 @@ READ = FLOW_ACCESS_READ
 WRITE = FLOW_ACCESS_WRITE
 RW = FLOW_ACCESS_RW
 AFFINITY = 0x100          # ref: PARSEC_AFFINITY bit on a dtd param
+NOTRACK = 0x200           # ref: PARSEC_DONT_TRACK (dtd_test_flag_dont_track.c):
+                          # the tile's VALUE flows to the body, but the access
+                          # creates no RAW/WAR/WAW edges and no distributed
+                          # version bookkeeping — ordering w.r.t. tracked
+                          # accesses of the same tile is the caller's problem.
+                          # Rank-local by contract (like tile_new scratch).
 
 mca.register("dtd_window_size", 2048,
              "Max in-flight inserted-but-not-executed tasks", type=int)
@@ -336,7 +342,9 @@ class DTDTaskpool(Taskpool):
 
         ``args``: ``(tile, access)`` tuples become data flows; anything else
         is a by-value parameter. ``access`` may carry the AFFINITY bit to pick
-        the task's rank (default: first WRITE tile's rank).
+        the task's rank (default: first WRITE tile's rank) and/or the
+        NOTRACK bit to pass the tile's value without dependency tracking
+        (ref PARSEC_DONT_TRACK).
         """
         if not self._open:
             output.fatal("insert_task on a closed DTD taskpool")
@@ -368,14 +376,23 @@ class DTDTaskpool(Taskpool):
         task = DTDTask(self, tc, priority)
         task.arg_spec = arg_spec
         task.tiles = tiles
-        # owner-computes rank (ref: rank from affinity tile's rank_of_key)
+        # owner-computes rank (ref: rank from affinity tile's rank_of_key);
+        # untracked flows don't steer placement
         if affinity_tile is None:
             for t, acc in zip(tiles, flow_accesses):
-                if acc & WRITE:
+                if acc & WRITE and not acc & NOTRACK:
                     affinity_tile = t
                     break
-            if affinity_tile is None and tiles:
-                affinity_tile = tiles[0]
+            if affinity_tile is None:
+                # fallback prefers tracked flows too: an untracked scratch
+                # tile is rank-local and would diverge owner-computes
+                # placement across the distributed replay
+                tracked = [t for t, acc in zip(tiles, flow_accesses)
+                           if not acc & NOTRACK]
+                if tracked:
+                    affinity_tile = tracked[0]
+                elif tiles:
+                    affinity_tile = tiles[0]
         task.rank = affinity_tile.rank if affinity_tile is not None else self.ctx.my_rank
         task.ident = self.inserted
         self.inserted += 1
@@ -407,6 +424,11 @@ class DTDTaskpool(Taskpool):
 
     def _link_tile(self, task: DTDTask, tile: DTDTile, acc: int,
                    flow_index: int, remote: bool, distributed: bool) -> None:
+        if acc & NOTRACK:
+            # untracked access: the value still reaches the body through
+            # _prepare_input's newest_copy resolution, but no chaining, no
+            # version bump, no comm bookkeeping, no audit entry
+            return
         my = self.ctx.my_rank
         preds: List[DTDTask] = []
         with tile.lock:

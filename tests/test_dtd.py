@@ -172,3 +172,67 @@ def test_flush_all(ctx):
     tp.close()
     ctx.wait()
     assert np.allclose(A.to_dense(), 42.0)
+
+
+def test_notrack_flag_skips_dependency_chaining(ctx):
+    """NOTRACK (ref PARSEC_DONT_TRACK, dtd_test_flag_dont_track.c): the
+    value flows to the body but the access creates no RAW/WAR/WAW edges."""
+    from parsec_tpu.dsl.dtd import NOTRACK
+    A = TiledMatrix("Ant", 8, 8, 8, 8)
+    A.fill(lambda m, n: np.full((8, 8), 5.0, np.float32))
+    tp = DTDTaskpool(ctx, "notrack")
+    t = tp.tile_of(A, 0, 0)
+
+    writer = tp.insert_task(lambda a: a + 1.0, (t, RW), name="W")
+    # a TRACKED read chains on the writer; an UNTRACKED read does not
+    # (deps_remaining == 0 means ready; reading it does not consume deps)
+    tracked = tp.insert_task(lambda a: None, (t, READ), jit=False, name="R")
+    untracked = tp.insert_task(lambda a: None, (t, READ | NOTRACK),
+                               jit=False, name="U")
+    assert tracked.deps_remaining == 1 or writer.completed
+    assert untracked.deps_remaining == 0
+    assert untracked not in t.readers
+    # an untracked WRITE neither joins nor resets the chain
+    uw = tp.insert_task(lambda a: a * 2.0, (t, RW | NOTRACK), name="UW")
+    assert uw.deps_remaining == 0
+    assert t.last_writer is writer
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    # both writes landed, in an UNDEFINED order (that is the NOTRACK
+    # contract): (5+1)*2 = 12 or 5*2+1 = 11
+    val = float(np.asarray(A.data_of(0, 0).newest_copy().payload)[0, 0])
+    assert val in (11.0, 12.0), val
+
+
+def test_notrack_value_reaches_body(ctx):
+    """The untracked tile's CURRENT value is what the body sees."""
+    from parsec_tpu.dsl.dtd import NOTRACK
+    A = TiledMatrix("Antv", 8, 8, 8, 8)
+    A.fill(lambda m, n: np.full((8, 8), 3.0, np.float32))
+    B = TiledMatrix("Bntv", 8, 8, 8, 8)
+    B.fill(lambda m, n: np.zeros((8, 8), np.float32))
+    tp = DTDTaskpool(ctx, "notrack-val")
+    ta, tb = tp.tile_of(A, 0, 0), tp.tile_of(B, 0, 0)
+    tp.insert_task(lambda scratch, out: out + scratch,
+                   (ta, READ | NOTRACK), (tb, RW), name="ADD")
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert np.allclose(B.to_dense(), 3.0)
+
+
+def test_notrack_does_not_steer_placement(ctx):
+    """Owner-computes fallback must skip NOTRACK flows: a task whose only
+    tracked flow is a READ on a collection tile takes THAT tile's rank,
+    even when an untracked scratch tile comes first."""
+    from parsec_tpu.dsl.dtd import NOTRACK
+    A = TiledMatrix("Antp", 8, 8, 8, 8)
+    A.fill(lambda m, n: np.zeros((8, 8), np.float32))
+    tp = DTDTaskpool(ctx, "notrack-place")
+    scratch = tp.tile_new((8, 8))
+    t = tp.tile_of(A, 0, 0)
+    task = tp.insert_task(lambda s, a: None, (scratch, RW | NOTRACK),
+                          (t, READ), jit=False, name="P")
+    assert task.rank == t.rank
+    tp.wait(); tp.close(); ctx.wait()
