@@ -231,8 +231,13 @@ def test_notrack_does_not_steer_placement(ctx):
     A.fill(lambda m, n: np.zeros((8, 8), np.float32))
     tp = DTDTaskpool(ctx, "notrack-place")
     scratch = tp.tile_new((8, 8))
+    # single-rank contexts make every tile rank 0; a sentinel rank on the
+    # scratch tile makes the assertion discriminating (the old fallback
+    # picked tiles[0] = scratch and would yield rank 7 here)
+    scratch.rank = 7
     t = tp.tile_of(A, 0, 0)
     task = tp.insert_task(lambda s, a: None, (scratch, RW | NOTRACK),
                           (t, READ), jit=False, name="P")
-    assert task.rank == t.rank
+    assert task.rank == t.rank == 0
+    scratch.rank = ctx.my_rank
     tp.wait(); tp.close(); ctx.wait()

@@ -1,0 +1,157 @@
+"""Randomized-DAG differential fuzzer.
+
+The reference validates its runtime with a battery of hand-written apps;
+this is the generative equivalent: random tile DAGs (random access
+patterns — RW chains, fan-in reads, pure readers) executed through every
+execution mode the framework has, each compared against the sequential
+numpy replay of the same insertion order (DTD's sequential-consistency
+ground truth):
+
+* scheduler, 1 worker
+* scheduler, 4 workers (races in release/scheduling paths)
+* graph capture (one XLA executable)
+* 2-rank distributed (threads fabric, owner-computes + real protocol)
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.context import Context
+from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+from parsec_tpu.dsl.dtd import DTDTaskpool, READ, RW
+
+TS = 4          # tile side
+NT = 6          # tiles in play
+NTASKS = 60
+
+
+def _body1(w, c0, c1):
+    return w * c0 + c1
+
+
+def _body2(w, r1, c0, c1):
+    return w * c0 + r1 + c1
+
+
+def _body3(w, r1, r2, c0, c1):
+    return w * c0 + r1 - r2 + c1
+
+
+def _reader(r1, c0, c1):
+    return None
+
+
+_BODIES = {1: _body1, 2: _body2, 3: _body3}
+
+
+def random_dag(seed: int):
+    """[(kind, write_ix, read_ixs, c0, c1)] with deterministic constants."""
+    rng = np.random.default_rng(seed)
+    tasks = []
+    for _ in range(NTASKS):
+        if rng.random() < 0.15:
+            tasks.append(("read", None, [int(rng.integers(NT))],
+                          0.0, 0.0))
+            continue
+        w = int(rng.integers(NT))
+        n_reads = int(rng.integers(0, 3))
+        reads = [int(v) for v in rng.choice(
+            [i for i in range(NT) if i != w], size=n_reads, replace=False)]
+        c0 = round(float(rng.uniform(0.5, 1.5)), 3)
+        c1 = round(float(rng.uniform(-1.0, 1.0)), 3)
+        tasks.append(("write", w, reads, c0, c1))
+    return tasks
+
+
+def numpy_replay(tasks, init):
+    """Sequential ground truth: DTD semantics == insertion-order replay."""
+    tiles = [init(i).copy() for i in range(NT)]
+    for kind, w, reads, c0, c1 in tasks:
+        if kind == "read":
+            continue
+        acc = tiles[w] * c0 + c1
+        if len(reads) >= 1:
+            acc = acc + tiles[reads[0]]
+        if len(reads) >= 2:
+            acc = acc - tiles[reads[1]]
+        tiles[w] = acc
+    return tiles
+
+
+def _init(i):
+    return np.full((TS, TS), float(i + 1), np.float32)
+
+
+def _insert_all(tp, tiles, tasks):
+    for kind, w, reads, c0, c1 in tasks:
+        if kind == "read":
+            tp.insert_task(_reader, (tiles[reads[0]], READ), c0, c1,
+                           name="RD")
+            continue
+        args = [(tiles[w], RW)] + [(tiles[r], READ) for r in reads]
+        tp.insert_task(_BODIES[1 + len(reads)], *args, c0, c1,
+                       name=f"W{1 + len(reads)}")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("mode", ["sched1", "sched4", "capture"])
+def test_fuzz_single_rank(seed, mode):
+    tasks = random_dag(seed)
+    ref = numpy_replay(tasks, _init)
+    ctx = Context(nb_cores=4 if mode == "sched4" else 1)
+    try:
+        A = TiledMatrix(f"F{mode}{seed}", NT * TS, TS, TS, TS)
+        A.fill(lambda m, n: _init(m))
+        tp = DTDTaskpool(ctx, f"fuzz-{mode}-{seed}",
+                         capture=(mode == "capture"))
+        tiles = [tp.tile_of(A, i, 0) for i in range(NT)]
+        _insert_all(tp, tiles, tasks)
+        tp.wait()
+        tp.close()
+        ctx.wait()
+        for i in range(NT):
+            got = np.asarray(A.data_of(i, 0).newest_copy().payload)
+            np.testing.assert_allclose(got, ref[i], rtol=1e-4, atol=1e-4,
+                                       err_msg=f"tile {i} ({mode}, {seed})")
+    finally:
+        ctx.fini()
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_fuzz_distributed_2rank(seed):
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.comm.threads import ThreadsCE, run_distributed
+    from parsec_tpu.utils import mca
+
+    tasks = random_dag(seed)
+    ref = numpy_replay(tasks, _init)
+    mca.set("dtd_audit", True)
+    try:
+        def program(rank, fabric):
+            ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+            RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+            A = TwoDimBlockCyclic(f"Fd{seed}", NT * TS, TS, TS, TS,
+                                  P=2, Q=1, nodes=2, myrank=rank)
+            A.fill(lambda m, n: _init(m))
+            tp = DTDTaskpool(ctx, f"fuzz-dist-{seed}")
+            tiles = [tp.tile_of(A, i, 0) for i in range(NT)]
+            _insert_all(tp, tiles, tasks)
+            tp.wait(timeout=120)
+            tp.close()
+            ctx.wait(timeout=120)
+            out = {i: np.asarray(A.data_of(i, 0).newest_copy().payload)
+                   for i in range(NT) if A.rank_of(i, 0) == rank}
+            ctx.fini()
+            return out
+
+        results = run_distributed(2, program, timeout=240)
+        merged = {}
+        for r in results:
+            merged.update(r)
+        assert sorted(merged) == list(range(NT))
+        for i in range(NT):
+            np.testing.assert_allclose(merged[i], ref[i], rtol=1e-4,
+                                       atol=1e-4,
+                                       err_msg=f"tile {i} (dist, {seed})")
+    finally:
+        mca.params.unset("dtd_audit")
