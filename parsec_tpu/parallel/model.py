@@ -92,6 +92,111 @@ def lm_loss(params: dict, tokens, targets, causal: bool = True,
 
 
 
+def _decode_block(bp, x, ck, cv, pos, scale):
+    """One transformer block for ONE new token at position ``pos`` against
+    KV caches (B, H, S, dh): the TPU-idiomatic incremental step — static
+    shapes, `dynamic_update_slice` cache writes, position-masked scores."""
+    import jax
+    import jax.numpy as jnp
+    h = _ln(x, bp["ln1_g"], bp["ln1_b"])                     # (B, 1, D)
+    qkv = jnp.einsum("bsd,chdk->cbhsk", h, bp["wqkv"])       # (3,B,H,1,dh)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, pos, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, pos, 0))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * scale         # (B,H,1,S)
+    k_pos = jnp.arange(ck.shape[2])
+    s = jnp.where(k_pos[None, None, None, :] <= pos, s, -jnp.inf)
+    a = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", a, cv)
+    x = x + jnp.einsum("bhsd,hdo->bso", o, bp["wo"])
+    h = _ln(x, bp["ln2_g"], bp["ln2_b"])
+    h = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
+    return x + h @ bp["w2"] + bp["b2"], ck, cv
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_generate(n_layers: int, prompt_len: int, n_tokens: int,
+                       greedy: bool, temperature: float):
+    import jax
+    import jax.numpy as jnp
+
+    def generate(params, prompt, key):
+        B = prompt.shape[0]
+        dh = params["blocks"][0]["wqkv"].shape[3]
+        S = prompt_len + n_tokens        # caches sized to what's generated
+        scale = 1.0 / float(np.sqrt(dh))
+
+        # ---- prefill: whole prompt in one pass through block_apply (the
+        # ONE source of full-forward block math), seeding the KV caches
+        x = params["embed"][prompt] + params["pos"][:prompt_len][None]
+        cks, cvs = [], []
+        for bp in params["blocks"]:
+            x, k, v = block_apply(bp, x, causal=True, return_kv=True)
+            pad = [(0, 0), (0, 0), (0, S - prompt_len), (0, 0)]
+            cks.append(jnp.pad(k, pad))
+            cvs.append(jnp.pad(v, pad))
+        h = _ln(x, params["lnf_g"], params["lnf_b"])
+        logits = jnp.einsum("bd,vd->bv", h[:, -1], params["embed"])
+
+        def sample(logits, key):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+            key_t = jax.random.fold_in(key, 0)
+            return jax.random.categorical(
+                key_t, logits / temperature, axis=-1).astype(prompt.dtype)
+
+        tok0 = sample(logits, key)
+
+        def step(carry, i):
+            tok, cks, cvs, key = carry
+            pos = prompt_len + i
+            x = params["embed"][tok][:, None, :] \
+                + jax.lax.dynamic_slice(params["pos"], (pos, 0),
+                                        (1, params["pos"].shape[1]))[None]
+            new_k, new_v = [], []
+            for li, bp in enumerate(params["blocks"]):
+                x, ck, cv = _decode_block(bp, x, cks[li], cvs[li], pos,
+                                          scale)
+                new_k.append(ck)
+                new_v.append(cv)
+            h = _ln(x, params["lnf_g"], params["lnf_b"])
+            logits = jnp.einsum("bd,vd->bv", h[:, 0], params["embed"])
+            key = jax.random.fold_in(key, i + 1)
+            nxt = sample(logits, key)
+            return (nxt, new_k, new_v, key), tok
+
+        (last, _, _, _), toks = jax.lax.scan(
+            step, (tok0, cks, cvs, key), jnp.arange(n_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)                     # (B, n-1)
+        return jnp.concatenate([prompt, toks, last[:, None]], axis=1)
+
+    return jax.jit(generate)
+
+
+def lm_generate(params: dict, prompt, n_tokens: int, greedy: bool = True,
+                temperature: float = 1.0, key=None):
+    """Autoregressive generation with per-layer KV caches: ONE compiled
+    program — full-prompt prefill seeds the caches, then a ``lax.scan``
+    decode loop (static shapes, `dynamic_update_slice` cache writes).
+    ``prompt`` (B, P) int32; returns (B, P + n_tokens). Greedy by default;
+    ``greedy=False`` samples at ``temperature`` using ``key``."""
+    import jax
+    prompt = np.asarray(prompt) if not hasattr(prompt, "dtype") else prompt
+    P = prompt.shape[1]
+    if n_tokens <= 0:
+        return prompt
+    if P + n_tokens > params["pos"].shape[0]:
+        raise ValueError(
+            f"prompt ({P}) + n_tokens ({n_tokens}) exceeds max_seq "
+            f"{params['pos'].shape[0]}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    fn = _compiled_generate(len(params["blocks"]), int(P), int(n_tokens),
+                            bool(greedy),
+                            1.0 if greedy else float(temperature))
+    return fn(params, prompt, key)
+
+
 def _lm_param_spec(mesh, dp: str, tp: str, n_layers: int):
     """Vocab-parallel embedding/head over ``tp``; Megatron block specs."""
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -76,13 +76,17 @@ def flash_attention_core(q, k, v, causal: bool, scale: float):
     return flash_attention(q, k, v, causal=causal, scale=scale)
 
 
-def block_apply(params, x, causal: bool = True, attention=None):
+def block_apply(params, x, causal: bool = True, attention=None,
+                return_kv: bool = False):
     """One pre-LN transformer block: x -> x + MHA(LN(x)) -> + MLP(LN(.)).
 
     ``x``: (batch, seq, d_model). Pure jax math — the sharding story is
     entirely in the jit annotations of :func:`make_train_step`.
     ``attention(q, k, v, causal, scale)`` swaps the attention core (the
-    sequence-parallel variant passes the ring)."""
+    sequence-parallel variant passes the ring). ``return_kv=True``
+    additionally returns this block's (k, v) — the KV-cache prefill seed
+    (:func:`parsec_tpu.parallel.model.lm_generate`) — so generation shares
+    THIS function's math rather than re-implementing it."""
     import jax
     import jax.numpy as jnp
     dh = params["wqkv"].shape[3]
@@ -95,7 +99,10 @@ def block_apply(params, x, causal: bool = True, attention=None):
 
     h = _ln(x, params["ln2_g"], params["ln2_b"])
     h = jax.nn.gelu(h @ params["w1"] + params["b1"])
-    return x + h @ params["w2"] + params["b2"]
+    out = x + h @ params["w2"] + params["b2"]
+    if return_kv:
+        return out, qkv[1], qkv[2]
+    return out
 
 
 def _param_spec(mesh, dp: str, tp: str):
