@@ -11,8 +11,10 @@ Runs on an 8-device virtual mesh (works anywhere):
    like their parameters.
 2. The full training state checkpoints through orbax
    (`utils/model_ckpt`) and training RESUMES bit-exact from the restore.
-3. The trained model greedy-decodes the memorized token stream, with the
-   Pallas flash-attention core doing the decode-time attention.
+3. The trained model reproduces the memorized token stream through
+   KV-cached greedy generation (`lm_generate`: prefill + lax.scan decode
+   in one compiled program), and the Pallas flash-attention core's
+   forward logits are checked against the dense core's.
 """
 import os
 import sys

@@ -64,26 +64,50 @@ def init_lm_params(seed: int, cfg: ModelConfig) -> dict:
     return p
 
 
-def lm_apply(params: dict, tokens, causal: bool = True, attention=None):
-    """tokens (B, S) int32 -> logits (B, S, V)."""
+def lm_apply(params: dict, tokens, causal: bool = True, attention=None,
+             remat: bool = False, compute_dtype=None):
+    """tokens (B, S) int32 -> logits (B, S, V).
+
+    TPU memory/throughput knobs (the brief's HBM levers):
+
+    * ``remat=True`` wraps each block in ``jax.checkpoint`` — activations
+      are recomputed in the backward pass instead of stored, trading
+      FLOPs for HBM (deep models / long sequences).
+    * ``compute_dtype=jnp.bfloat16`` runs the blocks in bf16 (the
+      MXU-native dtype) with f32 master params; the logits and loss stay
+      f32 (``preferred_element_type`` accumulation on the tied head).
+    """
+    import jax
     import jax.numpy as jnp
     S = tokens.shape[1]
     if S > params["pos"].shape[0]:
         raise ValueError(f"sequence length {S} exceeds the model's "
                          f"max_seq {params['pos'].shape[0]}")
+    blocks = params["blocks"]
     h = params["embed"][tokens] + params["pos"][:S][None, :, :]
-    for bp in params["blocks"]:
-        h = block_apply(bp, h, causal=causal, attention=attention)
-    h = _ln(h, params["lnf_g"], params["lnf_b"])
-    return jnp.einsum("bsd,vd->bsv", h, params["embed"])
+    if compute_dtype is not None:
+        cast = (lambda t: t.astype(compute_dtype)
+                if jnp.issubdtype(t.dtype, jnp.floating) else t)
+        h = cast(h)
+        blocks = jax.tree_util.tree_map(cast, blocks)
+    step = functools.partial(block_apply, causal=causal,
+                             attention=attention)
+    if remat:
+        step = jax.checkpoint(step)
+    for bp in blocks:
+        h = step(bp, h)
+    h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    return jnp.einsum("bsd,vd->bsv", h, params["embed"],
+                      preferred_element_type=jnp.float32)
 
 
 def lm_loss(params: dict, tokens, targets, causal: bool = True,
-            attention=None):
+            attention=None, remat: bool = False, compute_dtype=None):
     """Mean next-token cross-entropy; ``targets`` (B, S) int32."""
     import jax
     import jax.numpy as jnp
-    logits = lm_apply(params, tokens, causal=causal, attention=attention)
+    logits = lm_apply(params, tokens, causal=causal, attention=attention,
+                      remat=remat, compute_dtype=compute_dtype)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None],
                                axis=-1).squeeze(-1)
@@ -179,12 +203,15 @@ def lm_generate(params: dict, prompt, n_tokens: int, greedy: bool = True,
     program — full-prompt prefill seeds the caches, then a ``lax.scan``
     decode loop (static shapes, `dynamic_update_slice` cache writes).
     ``prompt`` (B, P) int32; returns (B, P + n_tokens). Greedy by default;
-    ``greedy=False`` samples at ``temperature`` using ``key``."""
+    ``greedy=False`` samples at ``temperature`` using ``key``
+    (``temperature <= 0`` means greedy)."""
     import jax
     prompt = np.asarray(prompt) if not hasattr(prompt, "dtype") else prompt
     P = prompt.shape[1]
     if n_tokens <= 0:
         return prompt
+    if temperature <= 0:
+        greedy = True
     if P + n_tokens > params["pos"].shape[0]:
         raise ValueError(
             f"prompt ({P}) + n_tokens ({n_tokens}) exceeds max_seq "
@@ -289,13 +316,17 @@ def _state_spec_like(mesh, pspec, params, state):
 
 
 def make_lm_opt_train_step(mesh, tx, params: dict, dp: str = "dp",
-                           tp: str = "tp", causal: bool = True):
+                           tp: str = "tp", causal: bool = True,
+                           remat: bool = False, compute_dtype=None):
     """An optax-powered LM training step over the (dp, tp) mesh.
 
     ``tx`` is any ``optax.GradientTransformation`` (e.g.
     ``optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(sched))``).
     Optimizer moments are sharded LIKE the parameters they mirror (see
-    :func:`_state_spec_like`). Returns
+    :func:`_state_spec_like`). ``remat``/``compute_dtype`` are the HBM
+    levers of :func:`lm_apply` (activation rematerialization; bf16
+    compute with f32 master params — grads arrive f32 via the cast's
+    transpose, so any optax transform composes unchanged). Returns
     ``(step, opt_state, place_params, place_batch)``::
 
         step, opt_state, place_p, place_t = make_lm_opt_train_step(
@@ -315,7 +346,9 @@ def make_lm_opt_train_step(mesh, tx, params: dict, dp: str = "dp",
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(
-            lambda p: lm_loss(p, tokens, targets, causal=causal))(params)
+            lambda p: lm_loss(p, tokens, targets, causal=causal,
+                              remat=remat,
+                              compute_dtype=compute_dtype))(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         import optax
         return optax.apply_updates(params, updates), opt_state, loss
