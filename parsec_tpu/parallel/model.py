@@ -224,6 +224,64 @@ def lm_generate(params: dict, prompt, n_tokens: int, greedy: bool = True,
     return fn(params, prompt, key)
 
 
+@functools.lru_cache(maxsize=None)
+def _lm_stage_fn(per: int, causal: bool):
+    """A STABLE stage function per (layers-per-stage, causal) — it keys
+    the pipeline's compiled-program cache, so it must not be a fresh
+    closure per call."""
+    def stage_fn(sp, act):
+        for i in range(per):
+            act = block_apply({k: v[i] for k, v in sp.items()}, act,
+                              causal=causal)
+        return act
+    return stage_fn
+
+
+def lm_pp_forward(params: dict, tokens, mesh=None,
+                  n_micro: Optional[int] = None, causal: bool = True):
+    """Pipeline-parallel LM forward: the blocks split into P contiguous
+    stage groups (device i owns layers [i·L/P, (i+1)·L/P)), microbatches
+    of the batch stream through the GPipe schedule
+    (:func:`parsec_tpu.parallel.pipeline.pipeline_forward_stages`);
+    embedding and the tied head run replicated outside the pipe.
+    ``tokens`` (B, S) with B divisible by ``n_micro``; returns logits
+    (B, S, V) matching :func:`lm_apply`."""
+    import jax
+    import jax.numpy as jnp
+    from .pipeline import make_pp_mesh, pipeline_forward_stages
+
+    mesh = mesh if mesh is not None else make_pp_mesh()
+    nP = mesh.devices.size
+    L = len(params["blocks"])
+    if L % nP:
+        raise ValueError(f"{L} layers do not split over {nP} stages")
+    per = L // nP
+    B, S = tokens.shape
+    if S > params["pos"].shape[0]:
+        raise ValueError(f"sequence length {S} exceeds the model's "
+                         f"max_seq {params['pos'].shape[0]}")
+    m = int(n_micro) if n_micro is not None else nP
+    if B % m:
+        raise ValueError(f"batch {B} not divisible by n_micro {m}")
+
+    b0 = params["blocks"][0]
+    stage_params = {
+        k: jnp.stack([jnp.stack([params["blocks"][s * per + i][k]
+                                 for i in range(per)])
+                      for s in range(nP)])
+        for k in b0
+    }                                   # every leaf: (P, per, ...)
+    stage_fn = _lm_stage_fn(per, causal)
+
+    x = params["embed"][tokens] + params["pos"][:S][None]
+    xs = x.reshape(m, B // m, S, x.shape[-1])
+    out = pipeline_forward_stages(stage_params, xs, stage_fn, mesh=mesh,
+                                  n_micro=m)
+    h = _ln(out.reshape(B, S, -1), params["lnf_g"], params["lnf_b"])
+    return jnp.einsum("bsd,vd->bsv", h, params["embed"],
+                      preferred_element_type=jnp.float32)
+
+
 def _lm_param_spec(mesh, dp: str, tp: str, n_layers: int):
     """Vocab-parallel embedding/head over ``tp``; Megatron block specs."""
     from jax.sharding import NamedSharding, PartitionSpec as P

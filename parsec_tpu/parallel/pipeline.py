@@ -49,8 +49,19 @@ def reference_forward(params, x):
     return out
 
 
+def _mlp_stage(sp, x):
+    """The simple-MLP stage as a stage-pytree fn (the original pipeline)."""
+    return stage_apply(sp["w"], sp["b"], x)
+
+
 @functools.lru_cache(maxsize=None)
-def _pipe_call(mesh, n_micro: int):
+def _pipe_stages_call(mesh, n_micro: int, stage_fn: Callable):
+    """The (M + P - 1)-tick GPipe schedule for an ARBITRARY stage pytree
+    (leading axis = stage) and stage function
+    ``stage_fn(stage_params, act) -> act`` — e.g. a group of transformer
+    blocks. ``stage_fn`` must be jit-traceable and shape-preserving.
+    Returns a ``run(sp, xs)`` whose jitted shard_map program is built ONCE
+    per stage-pytree structure (jax's own trace cache handles shapes)."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -60,25 +71,22 @@ def _pipe_call(mesh, n_micro: int):
     nP = mesh.devices.size
     perm = [(i, (i + 1) % nP) for i in range(nP)]
 
-    def local(w, b, xs):
-        # w: (1, d, d) this device's stage; xs: (n_micro, B, d) microbatches
-        # (replicated input; stage 0 consumes them in order)
+    def local(sp, xs):
         idx = jax.lax.axis_index(axis)
-        w0, b0 = w[0], b[0]
-        # zero initials derived from the (device-varying) stage weights so
-        # the scan carry is varying from step 0 (shard_map's manual-axes
-        # type system requires carry-in == carry-out)
-        zv = w0[0, 0] * 0.0
+        p0 = jax.tree_util.tree_map(lambda l: l[0], sp)   # my stage's slice
+        # derive the zero bubble from a device-varying leaf so the scan
+        # carry is varying from step 0 (manual-axes typing)
+        zv = jax.tree_util.tree_leaves(p0)[0].ravel()[0] * 0.0
         act = jnp.zeros(xs.shape[1:], xs.dtype) + zv   # the in-flight bubble
         out = jnp.zeros_like(xs) + zv       # filled on the LAST stage
 
         def tick(carry, t):
             act, out = carry
             # stage 0 ingests microbatch t (while t < n_micro)
-            feed = jnp.where(t < n_micro, 1.0, 0.0)
+            feed = jnp.where(t < n_micro, 1.0, 0.0).astype(xs.dtype)
             mb = xs[jnp.minimum(t, n_micro - 1)]
             act = jnp.where(idx == 0, feed * mb, act)
-            act = stage_apply(w0, b0, act)
+            act = stage_fn(p0, act)
             # the LAST stage retires microbatch t-(P-1)
             done = t - (nP - 1)
             is_out = jnp.logical_and(idx == nP - 1, done >= 0)
@@ -93,35 +101,67 @@ def _pipe_call(mesh, n_micro: int):
         # one psum replicates them (tiny shapes; fine for validation/driver)
         return jax.lax.psum(jnp.where(idx == nP - 1, out, 0.0), axis)
 
-    return jax.jit(shard_map(
-        local, mesh=mesh,
-        in_specs=(P(axis, None, None), P(axis, None), P()),
-        out_specs=P()))
+    def spec_of(leaf):
+        return P(axis, *([None] * (leaf.ndim - 1)))
+
+    jitted = {}     # one compiled wrapper per stage-pytree structure
+
+    def run(sp, xs):
+        key = (jax.tree_util.tree_structure(sp),
+               tuple(l.ndim for l in jax.tree_util.tree_leaves(sp)))
+        fn = jitted.get(key)
+        if fn is None:
+            in_specs = (jax.tree_util.tree_map(spec_of, sp), P())
+            fn = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                   out_specs=P()))
+            jitted[key] = fn
+        return fn(sp, xs)
+
+    return run
 
 
-def pipeline_forward(params, x, mesh=None, n_micro: Optional[int] = None):
-    """Run (n_micro, B, d) microbatches through the P-stage pipeline.
-
-    ``params['w']``: (P, d, d) — stage i's weights live on device i.
-    Returns (n_micro, B, d), matching :func:`reference_forward` applied per
-    microbatch within float32 tolerance.
-    """
+def pipeline_forward_stages(stage_params, x, stage_fn, mesh=None,
+                            n_micro: Optional[int] = None):
+    """GPipe over an arbitrary stage pytree: every leaf of
+    ``stage_params`` has leading axis P (stage-major); device i runs
+    ``stage_fn(stage_i_params, act)``. ``x``: (n_micro, B, ...)
+    microbatches; returns the same shape. ``stage_fn`` must be a STABLE
+    function object (module-level or cached) — it keys the compiled
+    program cache."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = mesh if mesh is not None else make_pp_mesh()
     axis = mesh.axis_names[0]
     nP = mesh.devices.size
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    assert leaves and all(l.shape[0] == nP for l in leaves), \
+        f"every stage-params leaf needs leading axis {nP} (the stage axis)"
+    xs = np.asarray(x) if not hasattr(x, "dtype") else x
+    m = int(n_micro) if n_micro is not None else xs.shape[0]
+    assert m <= xs.shape[0], \
+        f"n_micro={m} exceeds the {xs.shape[0]} provided microbatches"
+    xs = xs[:m]        # honor the (n_micro, B, ...) return contract exactly
+    run = _pipe_stages_call(mesh, m, stage_fn)
+    sp = jax.tree_util.tree_map(
+        lambda l: jax.device_put(
+            l, NamedSharding(mesh, P(axis, *([None] * (l.ndim - 1))))),
+        stage_params)
+    xd = jax.device_put(xs, NamedSharding(mesh, P()))
+    return run(sp, xd)
+
+
+def pipeline_forward(params, x, mesh=None, n_micro: Optional[int] = None):
+    """Run (n_micro, B, d) microbatches through the P-stage MLP pipeline
+    (the :func:`pipeline_forward_stages` schedule with the simple-MLP
+    stage). ``params['w']``: (P, d, d) — stage i's weights live on
+    device i. Returns (n_micro, B, d), matching :func:`reference_forward`
+    applied per microbatch within float32 tolerance."""
+    mesh = mesh if mesh is not None else make_pp_mesh()
+    nP = mesh.devices.size
     assert params["w"].shape[0] == nP, \
         f"{params['w'].shape[0]} stages need a {params['w'].shape[0]}-device" \
         f" mesh (have {nP})"
-    xs = np.asarray(x)
-    m = n_micro if n_micro is not None else xs.shape[0]
-    assert m <= xs.shape[0], \
-        f"n_micro={m} exceeds the {xs.shape[0]} provided microbatches"
-    xs = xs[:m]        # honor the (n_micro, B, d) return contract exactly
-    fn = _pipe_call(mesh, m)
-    wd = jax.device_put(params["w"], NamedSharding(mesh, P(axis, None, None)))
-    bd = jax.device_put(params["b"], NamedSharding(mesh, P(axis, None)))
-    xd = jax.device_put(xs, NamedSharding(mesh, P()))
-    return fn(wd, bd, xd)
+    return pipeline_forward_stages(
+        {"w": params["w"], "b": params["b"]}, x, _mlp_stage, mesh=mesh,
+        n_micro=n_micro)

@@ -321,3 +321,25 @@ def test_lm_generate_temperature_zero_is_greedy():
     t0 = np.asarray(lm_generate(params, prompt, 8, greedy=False,
                                 temperature=0.0))
     np.testing.assert_array_equal(g, t0)
+
+
+def test_lm_pipeline_parallel_forward_matches_dense():
+    """LM over pp stages (GPipe microbatch streaming) == lm_apply."""
+    import jax
+    from parsec_tpu.parallel.model import lm_pp_forward
+    from parsec_tpu.parallel.pipeline import make_pp_mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    rng = np.random.default_rng(14)
+    cfg = ModelConfig(vocab_size=32, d_model=32, d_ff=64, n_heads=4,
+                      n_layers=4, max_seq=16)
+    params = init_lm_params(14, cfg)
+    toks = rng.integers(0, 32, size=(8, 16)).astype(np.int32)
+    for nP, m in ((2, 4), (4, 2)):
+        mesh = make_pp_mesh(nP)
+        out = np.asarray(lm_pp_forward(params, toks, mesh=mesh, n_micro=m))
+        ref = np.asarray(lm_apply(params, toks))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"pp={nP} micro={m}")
+    with pytest.raises(ValueError, match="stages"):
+        lm_pp_forward(params, toks, mesh=make_pp_mesh(8))
