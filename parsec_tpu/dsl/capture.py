@@ -40,7 +40,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..utils import output
+from ..utils import mca, output
+
+mca.register("capture_scan_threshold", 64,
+             help="op count at which capture='auto' switches from inline "
+                  "replay to the scanned task interpreter")
 
 #: process-wide compiled-program cache: the same DAG shape (op sequence,
 #: tile shapes/dtypes, scalar params) compiles exactly once. Keys hold the
@@ -54,10 +58,39 @@ _cache_lock = threading.Lock()
 
 
 class GraphCapture:
-    """Recorder + compiler for a captured DTD taskpool."""
+    """Recorder + compiler for a captured DTD taskpool.
 
-    def __init__(self, tp) -> None:
+    Two compilation strategies:
+
+    * ``inline`` — replay every body in insertion order under one ``jax.jit``;
+      the DAG appears as XLA value dependencies. Program size is O(tasks):
+      ideal for small/medium DAGs of cheap-to-inline ops (dots fuse), but
+      decompose-heavy ops (cholesky / triangular_solve) inlined N times
+      compile superlinearly and execute far slower than the same op iterated
+      (measured on-chip: a 20-op POTRF DAG at 25-60x its op-sum).
+    * ``scan`` — the DAG as a scanned TASK INTERPRETER: tiles live in
+      per-(shape,dtype) stacked stores, ops become descriptor rows
+      (class id + store indices), and one ``lax.scan`` steps through them
+      with ``lax.switch`` over task CLASSES. Program size is O(distinct
+      classes) — PTG's task-class insight applied to XLA program size.
+      Insertion order is a valid serialization of the DAG (DTD sequential
+      consistency), and a single chip executes HLO serially anyway, so the
+      serialized replay costs nothing real; each step pays one tile
+      gather/scatter per flow. Descriptor rows are runtime DATA, so any DAG
+      with the same classes/op-count/store-geometry reuses the executable.
+
+    ``auto`` picks inline below ``--mca capture_scan_threshold`` ops (default
+    64) and scan above it when the recording is scannable (no raw-array
+    args; per-class homogeneous shapes — scalar args are baked per class).
+    """
+
+    def __init__(self, tp, mode: str = "auto") -> None:
         self.tp = tp
+        if mode is True:
+            mode = "auto"
+        if mode not in ("auto", "inline", "scan"):
+            output.fatal(f"capture mode {mode!r} not in auto|inline|scan")
+        self.mode = mode
         #: per op: (fn, spec); spec entries are
         #: ("flow", tile_index, access) | ("scalar", value) | ("array", arr)
         self.ops: List[Tuple[Any, List[Tuple]]] = []
@@ -65,6 +98,7 @@ class GraphCapture:
         self._tile_ix: Dict[int, int] = {}   # id(tile) -> index
         self.cache_hit = False
         self.executions = 0
+        self.last_mode: Optional[str] = None   # strategy of the last execute
 
     # ------------------------------------------------------------ recording
     def record(self, fn, args: Sequence[Any], jit: bool, name: str) -> None:
@@ -148,6 +182,146 @@ class GraphCapture:
             for wi, out in zip(wixs, outs):
                 write(wi, out)
 
+    # ------------------------------------------------------ scan interpreter
+    def _scan_plan(self, tile_vals: List[Any]):
+        """Lower the recording to task-class form for the scan interpreter.
+
+        Returns ``(stores, tile_loc, classes, rows)`` or None when the
+        recording is not scannable:
+
+        * ``stores``   — list of [tile_index...] per (shape, dtype) group;
+        * ``tile_loc`` — tile_index -> (store_id, slot);
+        * ``classes``  — list of (fn, slots) in first-appearance order,
+          where slots is a tuple of ("flow", flow_pos, store_id, acc) |
+          ("scalar", value) per body argument — scalar values are BAKED
+          into the class (two ops differing in a scalar are two classes);
+        * ``rows``     — per op: (class_id, [store slot per flow]).
+        """
+        store_ix: Dict[Tuple, int] = {}
+        stores: List[List[int]] = []
+        tile_loc: List[Tuple[int, int]] = []
+        for i, v in enumerate(tile_vals):
+            key = (tuple(np.shape(v)), str(getattr(v, "dtype", type(v))))
+            sid = store_ix.get(key)
+            if sid is None:
+                sid = store_ix[key] = len(stores)
+                stores.append([])
+            tile_loc.append((sid, len(stores[sid])))
+            stores[sid].append(i)
+
+        class_ix: Dict[Tuple, int] = {}
+        classes: List[Tuple[Any, Tuple]] = []
+        rows: List[Tuple[int, List[int]]] = []
+        for fn, spec in self.ops:
+            slots: List[Tuple] = []
+            flow_slots: List[int] = []
+            fp = 0
+            for e in spec:
+                if e[0] == "flow":
+                    sid, slot = tile_loc[e[1]]
+                    slots.append(("flow", fp, sid, e[2]))
+                    flow_slots.append(slot)
+                    fp += 1
+                elif e[0] == "scalar":
+                    slots.append(("scalar", e[1]))
+                else:
+                    return None          # raw-array args: not scannable
+            ckey = (fn, tuple(slots))
+            cid = class_ix.get(ckey)
+            if cid is None:
+                cid = class_ix[ckey] = len(classes)
+                classes.append((fn, tuple(slots)))
+            rows.append((cid, flow_slots))
+        return stores, tile_loc, classes, rows
+
+    def _build_scan(self, classes):
+        """The scanned-interpreter program: one lax.scan over descriptor
+        rows, lax.switch over task classes. Descriptor rows are runtime
+        data — the executable depends only on classes, store shapes and op
+        count."""
+        import jax
+        from jax import lax
+        from .dtd import WRITE
+
+        def make_branch(fn, slots):
+            def branch(stores, row):
+                stores = list(stores)
+                ins, wr = [], []
+                for sd in slots:
+                    if sd[0] == "flow":
+                        _, fp, sid, acc = sd
+                        ins.append(lax.dynamic_index_in_dim(
+                            stores[sid], row[fp], axis=0, keepdims=False))
+                        if acc & WRITE:
+                            wr.append((fp, sid))
+                    else:
+                        ins.append(sd[1])
+                outs = fn(*ins)
+                if outs is None:
+                    outs = ()
+                elif not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                for (fp, sid), out in zip(wr, outs):
+                    stores[sid] = lax.dynamic_update_index_in_dim(
+                        stores[sid], out.astype(stores[sid].dtype),
+                        row[fp], axis=0)
+                return tuple(stores)
+            return branch
+
+        branches = [make_branch(fn, slots) for fn, slots in classes]
+
+        def program(store_vals, class_ids, flow_idx):
+            def step(stores, x):
+                cid, row = x
+                if len(branches) == 1:
+                    return branches[0](stores, row), None
+                return lax.switch(cid, branches, stores, row), None
+            out, _ = jax.lax.scan(step, tuple(store_vals),
+                                  (class_ids, flow_idx))
+            return out
+
+        return program
+
+    def _execute_scan(self, tile_vals, plan):
+        """Run the scan interpreter; returns (written tile indices, their
+        values) for landing."""
+        import jax
+        import jax.numpy as jnp
+
+        stores, tile_loc, classes, rows = plan
+        n_flows_max = max((len(fs) for _, fs in rows), default=0)
+        class_ids = np.asarray([cid for cid, _ in rows], np.int32)
+        flow_idx = np.zeros((len(rows), max(n_flows_max, 1)), np.int32)
+        for i, (_, fs) in enumerate(rows):
+            flow_idx[i, :len(fs)] = fs
+
+        sig = ("scan",
+               tuple((fn, slots) for fn, slots in classes),
+               tuple((len(ixs),) + tuple(np.shape(tile_vals[ixs[0]]))
+                     + (str(getattr(tile_vals[ixs[0]], "dtype", "")),)
+                     for ixs in stores),
+               len(rows), flow_idx.shape[1])
+        with _cache_lock:
+            jitted = _program_cache.get(sig)
+            self.cache_hit = jitted is not None
+            if jitted is None:
+                jitted = jax.jit(self._build_scan(classes))
+                _program_cache[sig] = jitted
+                while len(_program_cache) > _PROGRAM_CACHE_MAX:
+                    _program_cache.popitem(last=False)
+            else:
+                _program_cache.move_to_end(sig)
+
+        store_vals = tuple(jnp.stack([tile_vals[i] for i in ixs])
+                           for ixs in stores)
+        out_stores = jitted(store_vals, class_ids, flow_idx)
+        written = self._written()
+        vals = []
+        for ix in written:
+            sid, slot = tile_loc[ix]
+            vals.append(out_stores[sid][slot])
+        return written, vals
+
     def _build(self):
         """The single-device traced program: fold over a tile-value env."""
         ops = self.ops
@@ -182,20 +356,34 @@ class GraphCapture:
         arr_vals = [e[1] for _, spec in self.ops for e in spec
                     if e[0] == "array"]
 
-        sig = self._signature(tile_vals)
-        with _cache_lock:
-            jitted = _program_cache.get(sig)
-            self.cache_hit = jitted is not None
-            if jitted is None:
-                program, written = self._build()
-                jitted = (jax.jit(program), written)
-                _program_cache[sig] = jitted
-                while len(_program_cache) > _PROGRAM_CACHE_MAX:
-                    _program_cache.popitem(last=False)
-            else:
-                _program_cache.move_to_end(sig)
-        fn, written = jitted
-        results = fn(tuple(tile_vals), tuple(arr_vals))
+        mode, plan = self.mode, None
+        if mode == "auto":
+            if len(self.ops) >= mca.get("capture_scan_threshold", 64):
+                plan = self._scan_plan(tile_vals)
+            mode = "scan" if plan is not None else "inline"
+        elif mode == "scan":
+            plan = self._scan_plan(tile_vals)
+            if plan is None:
+                output.fatal("scan capture requires class-uniform ops "
+                             "(no raw-array arguments)")
+        self.last_mode = mode
+        if mode == "scan":
+            written, results = self._execute_scan(tile_vals, plan)
+        else:
+            sig = self._signature(tile_vals)
+            with _cache_lock:
+                jitted = _program_cache.get(sig)
+                self.cache_hit = jitted is not None
+                if jitted is None:
+                    program, written = self._build()
+                    jitted = (jax.jit(program), written)
+                    _program_cache[sig] = jitted
+                    while len(_program_cache) > _PROGRAM_CACHE_MAX:
+                        _program_cache.popitem(last=False)
+                else:
+                    _program_cache.move_to_end(sig)
+            fn, written = jitted
+            results = fn(tuple(tile_vals), tuple(arr_vals))
         # land results exactly like task completions would (cpu-hook tail)
         from ..data.data import COHERENCY_OWNED
         for ix, val in zip(written, results):

@@ -217,7 +217,7 @@ class DTDTaskpool(Taskpool):
     """Ref: parsec_dtd_taskpool_new (insert_function.c:1513)."""
 
     def __init__(self, context: Context, name: str = "dtd",
-                 capture: bool = False) -> None:
+                 capture=False) -> None:
         # per-context (i.e. per-rank) sequence number per base name: every
         # rank constructs its taskpools in the same order, so "dtd#3" means
         # the same pool on all ranks while two concurrently-live pools can
@@ -263,7 +263,8 @@ class DTDTaskpool(Taskpool):
                 output.fatal("graph capture is single-rank "
                              "(a captured pool never leaves the chip)")
             from .capture import GraphCapture
-            self._capture = GraphCapture(self)
+            # capture=True -> "auto"; or an explicit "inline"/"scan" strategy
+            self._capture = GraphCapture(self, mode=capture)
         self.addto_nb_pending_actions(1)
         self._open = True
         context.add_taskpool(self)

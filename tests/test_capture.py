@@ -412,3 +412,159 @@ def test_capture_stencil_matches_scheduler(ctx):
         return np.asarray(A.to_dense())     # iters even -> result in A
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------- scan-interpreter capture
+
+def test_scan_capture_gemm_matches_scheduler(ctx):
+    """The scanned task interpreter (capture="scan") produces the same tile
+    results as the scheduler on the tiled-GEMM DAG."""
+    n, ts = 64, 16
+    rng = np.random.default_rng(31)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+
+    A1, B1, C1 = _gemm_collections("zs", n, ts, a, b)
+    tp = DTDTaskpool(ctx, "zsched")
+    insert_gemm_tasks(tp, A1, B1, C1, batch_k=False)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=30)
+
+    A2, B2, C2 = _gemm_collections("zc", n, ts, a, b)
+    cap = DTDTaskpool(ctx, "zscan", capture="scan")
+    insert_gemm_tasks(cap, A2, B2, C2, batch_k=False)
+    cap.wait()
+    cap.close()
+    ctx.wait(timeout=30)
+    assert cap._capture.last_mode == "scan"
+
+    np.testing.assert_allclose(np.asarray(C2.to_dense()),
+                               np.asarray(C1.to_dense()), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(C2.to_dense()), a @ b,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_scan_capture_potrf_matches_scheduler(ctx):
+    """The DAG the scan mode exists for: POTRF's decompose-heavy bodies
+    (cholesky, triangular solves) appear ONCE per class in the program
+    instead of once per task."""
+    n, ts = 64, 16
+    spd = make_spd(n, seed=29)
+
+    P1 = TwoDimBlockCyclic("zp1", n, n, ts, ts, P=1, Q=1)
+    P1.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    tp = DTDTaskpool(ctx, "zp-sched")
+    insert_potrf_tasks(tp, P1)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=30)
+
+    P2 = TwoDimBlockCyclic("zp2", n, n, ts, ts, P=1, Q=1)
+    P2.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    cap = DTDTaskpool(ctx, "zp-scan", capture="scan")
+    insert_potrf_tasks(cap, P2)
+    cap.wait()
+    cap.close()
+    ctx.wait(timeout=30)
+    assert cap._capture.last_mode == "scan"
+
+    got = np.tril(np.asarray(P2.to_dense(), np.float64))
+    ref = np.tril(np.asarray(P1.to_dense(), np.float64))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_scan_capture_program_reuse_across_different_dags(ctx):
+    """Descriptor rows are runtime DATA: two DIFFERENT DAGs with the same
+    task classes, op count and store geometry share one compiled executable
+    (the PTG task-class insight applied to XLA program size)."""
+    ts = 8
+
+    def axpy(y, x):
+        return y + 2.0 * x
+
+    def run(perm, name):
+        cap = DTDTaskpool(ctx, name, capture="scan")
+        tiles = [cap.tile_new((ts, ts), np.float32) for _ in range(4)]
+        for i, t in enumerate(tiles):
+            t.data.create_copy(0, np.full((ts, ts), float(i), np.float32))
+        for dst, src in perm:                     # same class, different rows
+            cap.insert_task(axpy, (tiles[dst], RW), (tiles[src], READ))
+        cap.wait()
+        hit = cap._capture.cache_hit
+        cap.close()
+        ctx.wait(timeout=30)
+        vals = [np.asarray(t.data.newest_copy().payload)[0, 0] for t in tiles]
+        return hit, vals
+
+    hit1, v1 = run([(0, 1), (2, 3), (0, 2), (1, 3)], "zr1")
+    hit2, v2 = run([(3, 0), (1, 2), (3, 1), (2, 0)], "zr2")
+    assert not hit1 and hit2       # second DAG reuses the first's executable
+    # independent references (task graph semantics on the host side)
+    assert v1 == [0 + 2*1 + 2*(2 + 2*3), 1 + 2*3, 2 + 2*3, 3.0]
+    assert v2 == [0.0, 1 + 2*2, 2 + 2*0, 3 + 2*0 + 2*(1 + 2*2)]
+
+
+def test_scan_capture_scalar_args_split_classes(ctx):
+    """Scalar args are baked per class: ops differing only in a scalar are
+    distinct classes and produce distinct results."""
+    cap = DTDTaskpool(ctx, "zsc", capture="scan")
+    t1 = cap.tile_new((4, 4), np.float32)
+    t2 = cap.tile_new((4, 4), np.float32)
+    t1.data.create_copy(0, np.ones((4, 4), np.float32))
+    t2.data.create_copy(0, np.ones((4, 4), np.float32))
+
+    def scale(x, alpha):
+        return x * alpha
+
+    cap.insert_task(scale, (t1, RW), 3.0)
+    cap.insert_task(scale, (t2, RW), 5.0)
+    cap.wait()
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t1.data.newest_copy().payload), 3.0)
+    np.testing.assert_allclose(np.asarray(t2.data.newest_copy().payload), 5.0)
+
+
+def test_scan_capture_rejects_raw_array_args(ctx):
+    """Raw ndarray args are not scannable (they would bloat the descriptor
+    rows); explicit scan mode must fail loudly, auto must fall back."""
+    cap = DTDTaskpool(ctx, "zneg", capture="scan")
+    t = cap.tile_new((4, 4), np.float32)
+    t.data.create_copy(0, np.ones((4, 4), np.float32))
+    cap.insert_task(lambda x, b: x + b, (t, RW),
+                    np.zeros((4, 4), np.float32))
+    with pytest.raises(Exception, match="scan"):
+        cap.wait()
+    cap._capture.ops.clear()        # drop the unexecutable recording
+    cap.close()
+
+
+def test_auto_capture_picks_scan_above_threshold(ctx):
+    """capture=True (auto) stays inline below the MCA threshold and switches
+    to the scan interpreter above it."""
+    from parsec_tpu.utils import mca
+    old = mca.get("capture_scan_threshold", 64)
+    mca.set("capture_scan_threshold", 8)
+    try:
+        def bump(x):
+            return x + 1.0
+
+        def run(nops, name):
+            cap = DTDTaskpool(ctx, name, capture=True)
+            t = cap.tile_new((4, 4), np.float32)
+            t.data.create_copy(0, np.zeros((4, 4), np.float32))
+            for _ in range(nops):
+                cap.insert_task(bump, (t, RW))
+            cap.wait()
+            mode = cap._capture.last_mode
+            cap.close()
+            ctx.wait(timeout=30)
+            return mode, np.asarray(t.data.newest_copy().payload)[0, 0]
+
+        mode_small, v_small = run(4, "zat-s")
+        mode_big, v_big = run(16, "zat-b")
+        assert mode_small == "inline" and v_small == 4.0
+        assert mode_big == "scan" and v_big == 16.0
+    finally:
+        mca.set("capture_scan_threshold", old)
