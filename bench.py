@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Headline benchmark: tiled GEMM through the task runtime on one chip.
+"""Headline benchmark: tiled GEMM + POTRF through the task runtime on one chip.
 
 Mirrors the reference's DTD GEMM harness (tests/dsl/dtd/dtd_test_simple_gemm.c,
 gflops = 2·M·N·K/1e9/t at :1143-1161): the full tile DAG goes through
@@ -9,50 +9,221 @@ resident tiles), fused k-chains per C tile (the task-batching analogue).
 Baseline = raw XLA ``jnp.dot`` on the same operands on the same chip: the
 single-kernel ideal. ``vs_baseline`` is runtime-GFLOP/s over raw-GFLOP/s, i.e.
 how much task-runtime machinery costs relative to pure XLA (1.0 = free).
+``pct_of_peak_bf16`` states MFU against the chip's published bf16 peak.
+
+Robustness contract (a wedged TPU relay must never cost us the numbers):
+* the accelerator probe runs in a subprocess under a hard timeout, with one
+  retry + backoff, and its stderr tail is RECORDED in the output JSON;
+* partial results are persisted to ``bench_partial.json`` after every leg,
+  so a mid-bench wedge still leaves everything measured so far on disk;
+* the compile-riskiest leg (captured POTRF — the round-3 wedge trigger was a
+  timeout-killed POTRF compile) runs LAST, in a killable subprocess.
 
 Prints exactly ONE JSON line on stdout.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+PARTIAL_PATH = os.path.join(REPO, "bench_partial.json")
+
+#: published bf16 peak per chip generation, TFLOP/s / chip.
+#: (v5e: 197; v5p: 459; v4: 275; v6e "Trillium": 918; v3: 123)
+BF16_PEAK_TFLOPS = {
+    "v6e": 918.0, "v5p": 459.0, "v5e": 197.0, "v4": 275.0, "v3": 123.0,
+}
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    import subprocess
+def detect_chip(device_kind: str) -> tuple:
+    """(generation, bf16 peak TFLOP/s) from the device kind string and the
+    relay's env; ("", None) when unrecognized."""
+    s = " ".join([device_kind or "", os.environ.get("PALLAS_AXON_TPU_GEN", "")
+                  ]).lower()
+    for gen in ("v6e", "v5p", "v5e", "v4", "v3"):
+        if gen in s:
+            return gen, BF16_PEAK_TFLOPS[gen]
+    return "", None
 
-    import numpy as np
+
+def probe_accelerator():
+    """Decide the backend in a SUBPROCESS under a hard timeout: a wedged TPU
+    transport would hang any in-process backend init (and hold JAX's backend
+    lock), so the decision must be made before this process touches a backend
+    at all. Returns (platform, device_kind, attempts) where attempts carries
+    each try's return code and stderr tail — the round-1..3 artifacts lost
+    exactly this diagnostic."""
+    code = ("import jax, json; d = jax.devices()[0]; "
+            "print(json.dumps({'platform': d.platform, "
+            "'kind': getattr(d, 'device_kind', '')}))")
+    attempts = []
+    for attempt in range(2):
+        t0 = time.perf_counter()
+        try:
+            p = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=150)
+            rec = {"rc": p.returncode,
+                   "secs": round(time.perf_counter() - t0, 1),
+                   "stderr_tail": (p.stderr or "").strip()[-500:]}
+            attempts.append(rec)
+            if p.returncode == 0:
+                for line in reversed((p.stdout or "").strip().splitlines()):
+                    try:
+                        info = json.loads(line)
+                        return info.get("platform", ""), info.get("kind", ""), \
+                            attempts
+                    except ValueError:
+                        continue
+        except subprocess.TimeoutExpired as e:
+            err = e.stderr or b""
+            if isinstance(err, bytes):
+                err = err.decode("utf-8", "replace")
+            attempts.append({"rc": "timeout",
+                             "secs": round(time.perf_counter() - t0, 1),
+                             "stderr_tail": err.strip()[-500:]})
+        except Exception as e:  # pragma: no cover - defensive
+            attempts.append({"rc": f"error:{type(e).__name__}",
+                             "secs": round(time.perf_counter() - t0, 1),
+                             "stderr_tail": str(e)[-500:]})
+        log(f"accelerator probe attempt {attempt + 1} failed: "
+            f"{attempts[-1]['rc']}; stderr tail: "
+            f"{attempts[-1]['stderr_tail'][-200:]!r}")
+        if attempt == 0:
+            time.sleep(15)       # backoff: transient relay restarts recover
+    return "", "", attempts
+
+
+def setup_backend(platform: str):
+    """Select the jax backend for this process given the probe's verdict,
+    and turn on the persistent compilation cache (fewer live compiles =
+    fewer chances to wedge the relay; repeat DAG shapes become free)."""
     import jax
-
-    # probe the accelerator in a SUBPROCESS under a hard timeout: a wedged
-    # TPU transport would hang any in-process backend init (and hold JAX's
-    # backend lock), so the decision must be made before this process
-    # touches a backend at all
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=120)
-        platform = probe.stdout.strip().splitlines()[-1] if probe.returncode == 0 \
-            and probe.stdout.strip() else ""
-    except Exception:
-        platform = ""
     if platform not in ("tpu", "axon", "gpu"):
         log(f"accelerator probe said {platform!r}; forcing CPU backend")
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
+    try:
+        cache_dir = os.path.join(REPO, ".cache", "jax")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:
+        log(f"compilation cache unavailable: {e}")
+    return jax
+
+
+def _slope(t_lo, t_hi, d_lo, d_hi, label):
+    """Per-unit time from the (lo, hi) pair; when relay jitter swallows
+    the slope (t_hi barely above t_lo, or inverted), fall back to the
+    CONSERVATIVE t_hi/d_hi — it still contains the fixed barrier cost,
+    so the reported rate can only be an underestimate."""
+    s = (t_hi - t_lo) / (d_hi - d_lo)
+    if s <= 0.02 * t_hi / d_hi:
+        log(f"{label}: slope lost in jitter (T{d_lo}={t_lo*1e3:.1f}ms "
+            f"T{d_hi}={t_hi*1e3:.1f}ms); using conservative T/{d_hi}")
+        s = t_hi / d_hi
+    return s
+
+
+def potrf_captured_leg(platform: str) -> None:
+    """The compile-riskiest leg, runnable standalone (``--leg
+    potrf-captured``): whole-DAG captured Cholesky. Round 3's relay wedge
+    was triggered by a timeout-killed POTRF compile, so the parent runs
+    this in a killable subprocess AFTER everything else is safe on disk.
+    Prints one mini JSON line."""
+    jax = setup_backend(platform)
+    import functools as _ft
+    import numpy as np
+    import jax.numpy as jnp
+    import parsec_tpu as pt
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    from parsec_tpu.ops.potrf import insert_potrf_tasks, make_spd
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform in ("tpu", "axon")
+    N = 8192 if on_tpu else 2048
+    pN, pTS = N // 2, (2048 if on_tpu else 512) // 2
+    reps = 3 if on_tpu else 2
+    spd = make_spd(pN, seed=7)
+    ctx = pt.Context(nb_cores=1)
+    Pm = TwoDimBlockCyclic("Pcap", pN, pN, pTS, pTS, P=1, Q=1)
+    pmt = pN // pTS
+    fuse_tril = jax.jit(lambda ts: sum(t[0, 0].astype(jnp.float32)
+                                       for t in ts))
+
+    def run_potrf_captured(n_dags: int) -> float:
+        Pm.fill(lambda m, k: spd[m*pTS:(m+1)*pTS, k*pTS:(k+1)*pTS])
+        # "scan" strategy: the round-3 on-chip pathology (25-60x op-sum) was
+        # N inlined cholesky instances compiling superlinearly and running
+        # slow; the scanned task interpreter keeps ONE instance per class
+        tp = DTDTaskpool(ctx, "potrf-cap", capture="scan")
+        t0 = time.perf_counter()
+        for _ in range(n_dags):
+            insert_potrf_tasks(tp, Pm)
+            tp.wait()
+        tp.close()
+        s = fuse_tril([jnp.asarray(Pm.data_of(m, k).newest_copy().payload)
+                       for m in range(pmt) for k in range(m + 1)])
+        np.asarray(jax.device_get(s))
+        return time.perf_counter() - t0
+
+    t_compile = time.perf_counter()
+    run_potrf_captured(1)
+    t_compile = time.perf_counter() - t_compile
+    cpt_lo = min(run_potrf_captured(1) for _ in range(reps))
+    cpt_hi = min(run_potrf_captured(3) for _ in range(reps))
+    potrf_cap_s = _slope(cpt_lo, cpt_hi, 1, 3, "captured POTRF")
+    potrf_flops = pN ** 3 / 3.0
+    ctx.fini()
+    print(json.dumps({
+        "potrf_captured_gflops": round(potrf_flops / 1e9 / potrf_cap_s, 1),
+        "potrf_captured_compile_s": round(t_compile, 1),
+        "potrf_captured_mode": "scan",
+    }))
+
+
+def main() -> None:
+    import numpy as np
+
+    results = {"metric": "tiled-gemm-gflops", "value": 0.0,
+               "unit": "GFLOP/s", "vs_baseline": 0.0}
+
+    def persist(note=""):
+        try:
+            with open(PARTIAL_PATH, "w") as f:
+                json.dump(dict(results, _partial_note=note), f, indent=1)
+        except OSError:
+            pass
+
+    if os.environ.get("PT_BENCH_PLATFORM"):
+        # operator override: skip the (slow, 2x150s on a dead relay) probe
+        platform, kind, attempts = os.environ["PT_BENCH_PLATFORM"], "", \
+            [{"rc": "env-override"}]
+    else:
+        platform, kind, attempts = probe_accelerator()
+    results["probe"] = {"platform": platform, "device_kind": kind,
+                        "attempts": attempts}
+    persist("after probe")
+    jax = setup_backend(platform)
     devs = jax.devices()
     on_tpu = devs[0].platform in ("tpu", "axon")
     log(f"bench devices: {devs}")
+    chip_gen, peak_tflops = detect_chip(kind)
+    if on_tpu and peak_tflops:
+        results["chip"] = chip_gen
+        results["chip_peak_bf16_tflops"] = peak_tflops
 
     import parsec_tpu as pt
     from parsec_tpu.data.matrix import TwoDimBlockCyclic
@@ -84,6 +255,10 @@ def main() -> None:
     bench_dtype = jnp.bfloat16 if on_tpu else np.float32
     a_bench = a_host.astype(bench_dtype) if on_tpu else a_host
     b_bench = b_host.astype(bench_dtype) if on_tpu else b_host
+    results["platform"] = devs[0].platform
+    results["gemm_dtype"] = jnp.dtype(bench_dtype).name
+    results["timing"] = "slope+forced-barrier"
+    results["host_cores"] = os.cpu_count()
 
     # ---- raw XLA baseline on the same chip, same dtype --------------------
     # TIMING DISCIPLINE (tpu-via-relay): on the tunneled chip BOTH
@@ -105,18 +280,6 @@ def main() -> None:
         t0 = time.perf_counter()
         f()
         return time.perf_counter() - t0
-
-    def _slope(t_lo, t_hi, d_lo, d_hi, label):
-        """Per-unit time from the (lo, hi) pair; when relay jitter swallows
-        the slope (t_hi barely above t_lo, or inverted), fall back to the
-        CONSERVATIVE t_hi/d_hi — it still contains the fixed barrier cost,
-        so the reported rate can only be an underestimate."""
-        s = (t_hi - t_lo) / (d_hi - d_lo)
-        if s <= 0.02 * t_hi / d_hi:
-            log(f"{label}: slope lost in jitter (T{d_lo}={t_lo*1e3:.1f}ms "
-                f"T{d_hi}={t_hi*1e3:.1f}ms); using conservative T/{d_hi}")
-            s = t_hi / d_hi
-        return s
 
     @_ft.partial(jax.jit, static_argnums=2)
     def _dot_chain(x, b, k):
@@ -144,6 +307,11 @@ def main() -> None:
     raw_gflops = gemm_flops(N, N, N) / 1e9 / raw_s
     log(f"raw XLA dot ({jnp.dtype(bench_dtype).name}, slope {k_lo}->{k_hi}): "
         f"{raw_s*1e3:.2f} ms -> {raw_gflops:.1f} GFLOP/s")
+    results["raw_gemm_gflops"] = round(raw_gflops, 1)
+    if on_tpu and peak_tflops:
+        results["raw_pct_of_peak_bf16"] = round(
+            raw_gflops / (peak_tflops * 1e3) * 100, 1)
+    persist("after raw GEMM baseline")
 
     # ---- the task runtime -------------------------------------------------
     ctx = pt.Context(nb_cores=1)
@@ -163,22 +331,6 @@ def main() -> None:
     # forces completion of the whole DAG with ONE round-trip
     fuse_all = jax.jit(
         lambda ts: sum(t[0, 0].astype(jnp.float32) for t in ts))
-
-    def run_dags(n_dags: int) -> float:
-        """Insert the full tile-GEMM DAG n times into one taskpool (RW
-        chains on C serialize the repetitions per tile — steady state),
-        then force true completion. Returns wall seconds."""
-        tp = DTDTaskpool(ctx, "gemm")
-        t0 = time.perf_counter()
-        for _ in range(n_dags):
-            insert_gemm_tasks(tp, A, B, C, batch_k=True)
-        tp.wait()
-        tp.close()
-        ctx.wait()
-        s = fuse_all([jnp.asarray(C.data_of(m, n).newest_copy().payload)
-                      for m in range(mt) for n in range(mt)])
-        np.asarray(jax.device_get(s))
-        return time.perf_counter() - t0
 
     # ---- graph-capture mode first: the whole DAG as ONE XLA executable ----
     # (dsl/capture.py) — the framework's recommended single-chip mode for
@@ -206,6 +358,29 @@ def main() -> None:
     cap_gflops = gemm_flops(N, N, N) / 1e9 / cap_s
     log(f"captured tiled GEMM N={N} TS={TS}: {cap_s*1e3:.2f} ms -> "
         f"{cap_gflops:.1f} GFLOP/s")
+    results["gemm_captured_gflops"] = round(cap_gflops, 1)
+    results["value"] = round(cap_gflops, 1)
+    results["vs_baseline"] = round(cap_gflops / raw_gflops, 4)
+    if on_tpu and peak_tflops:
+        results["pct_of_peak_bf16"] = round(
+            cap_gflops / (peak_tflops * 1e3) * 100, 1)
+    persist("after captured GEMM")
+
+    def run_dags(n_dags: int) -> float:
+        """Insert the full tile-GEMM DAG n times into one taskpool (RW
+        chains on C serialize the repetitions per tile — steady state),
+        then force true completion. Returns wall seconds."""
+        tp = DTDTaskpool(ctx, "gemm")
+        t0 = time.perf_counter()
+        for _ in range(n_dags):
+            insert_gemm_tasks(tp, A, B, C, batch_k=True)
+        tp.wait()
+        tp.close()
+        ctx.wait()
+        s = fuse_all([jnp.asarray(C.data_of(m, n).newest_copy().payload)
+                      for m in range(mt) for n in range(mt)])
+        np.asarray(jax.device_get(s))
+        return time.perf_counter() - t0
 
     run_dags(1)          # warm: compiles the chain bodies
     t_lo = min(run_dags(d_lo) for _ in range(reps))
@@ -216,6 +391,13 @@ def main() -> None:
         f"DAGs): {sched_s*1e3:.2f} ms -> {sched_gflops:.1f} GFLOP/s "
         f"(T1 {t_lo*1e3:.1f} ms, T3 {t_hi*1e3:.1f} ms)")
     gflops = max(sched_gflops, cap_gflops)   # the framework's best mode
+    results["gemm_sched_gflops"] = round(sched_gflops, 1)
+    results["value"] = round(gflops, 1)
+    results["vs_baseline"] = round(gflops / raw_gflops, 4)
+    if on_tpu and peak_tflops:
+        results["pct_of_peak_bf16"] = round(
+            gflops / (peak_tflops * 1e3) * 100, 1)
+    persist("after scheduler GEMM")
 
     # small-size correctness gate (separate matrices, same code path)
     def mk_small(dcname, src):
@@ -238,6 +420,7 @@ def main() -> None:
     pN = N // 2          # SPD factorization at half the GEMM size
     pTS = TS // 2
     spd = make_spd(pN, seed=7)
+
     @_ft.partial(jax.jit, static_argnums=1)
     def _chol_chain(x, k):
         # same f32 'highest' MXU precision as the runtime's tile bodies;
@@ -263,6 +446,7 @@ def main() -> None:
     potrf_flops = pN ** 3 / 3.0
     raw_potrf_s = _slope(t_lo, t_hi, ck_lo, ck_hi, "raw cholesky")
     raw_potrf_gflops = potrf_flops / 1e9 / raw_potrf_s
+    results["raw_potrf_gflops"] = round(raw_potrf_gflops, 1)
 
     Pm = TwoDimBlockCyclic("Pbench", pN, pN, pTS, pTS, P=1, Q=1)
     pmt = pN // pTS
@@ -290,30 +474,14 @@ def main() -> None:
     pt_hi = min(run_potrf(3) for _ in range(reps))
     potrf_sched_s = _slope(pt_lo, pt_hi, 1, 3, "scheduler POTRF")
     potrf_sched_gflops = potrf_flops / 1e9 / potrf_sched_s
-
-    def run_potrf_captured(n_dags: int) -> float:
-        Pm.fill(lambda m, k: spd[m*pTS:(m+1)*pTS, k*pTS:(k+1)*pTS])
-        tp = DTDTaskpool(ctx, "potrf-cap", capture=True)
-        t0 = time.perf_counter()
-        for _ in range(n_dags):
-            insert_potrf_tasks(tp, Pm)
-            tp.wait()
-        tp.close()
-        s = fuse_tril([jnp.asarray(Pm.data_of(m, k).newest_copy().payload)
-                       for m in range(pmt) for k in range(m + 1)])
-        np.asarray(jax.device_get(s))
-        return time.perf_counter() - t0
-
-    run_potrf_captured(1)
-    cpt_lo = min(run_potrf_captured(1) for _ in range(reps))
-    cpt_hi = min(run_potrf_captured(3) for _ in range(reps))
-    potrf_cap_s = _slope(cpt_lo, cpt_hi, 1, 3, "captured POTRF")
-    potrf_cap_gflops = potrf_flops / 1e9 / potrf_cap_s
-    potrf_gflops = max(potrf_sched_gflops, potrf_cap_gflops)
-    log(f"DTD tiled POTRF N={pN} TS={pTS} (slope): scheduler "
-        f"{potrf_sched_s*1e3:.2f} ms -> {potrf_sched_gflops:.1f} GFLOP/s, "
-        f"captured {potrf_cap_s*1e3:.2f} ms -> {potrf_cap_gflops:.1f} "
-        f"GFLOP/s (raw XLA cholesky: {raw_potrf_gflops:.1f})")
+    log(f"DTD tiled POTRF N={pN} TS={pTS} (scheduler, slope): "
+        f"{potrf_sched_s*1e3:.2f} ms -> {potrf_sched_gflops:.1f} GFLOP/s "
+        f"(raw XLA cholesky: {raw_potrf_gflops:.1f})")
+    results["potrf_sched_gflops"] = round(potrf_sched_gflops, 1)
+    results["potrf_gflops"] = round(potrf_sched_gflops, 1)
+    results["potrf_vs_baseline"] = round(
+        potrf_sched_gflops / raw_potrf_gflops, 4)
+    persist("after scheduler POTRF")
 
     # small-size correctness gate for the same POTRF code path
     spd_s = make_spd(256, seed=11)
@@ -353,6 +521,8 @@ def main() -> None:
 
     tasks_per_sec = ptg_ep_rate(ctx)
     log(f"EP steady state (PTG, 1 core): {tasks_per_sec:,.0f} tasks/s")
+    results["tasks_per_sec"] = round(tasks_per_sec)
+    persist("after EP rate")
 
     # DTD dynamic-insert rate on the same graph shape
     from parsec_tpu.dsl.dtd import READ as pt_READ
@@ -373,18 +543,30 @@ def main() -> None:
         tp.wait(); tp.close(); ctx.wait()
         dtd_rate = max(dtd_rate, ntasks / (time.perf_counter() - t0))
     log(f"EP via DTD insert_task: {dtd_rate:,.0f} tasks/s")
+    results["dtd_insert_tasks_per_sec"] = round(dtd_rate)
     ctx.fini()
 
-    # multi-core scaling row (worker threads; this host exposes
-    # {os.cpu_count()} core(s) — oversubscribed threads measure the GIL
-    # ceiling, reported as-is)
-    scaling = {1: round(tasks_per_sec)}
-    for nc in (2, 4):
-        cscale = pt.Context(nb_cores=nc)
-        scaling[nc] = round(ptg_ep_rate(cscale, reps_=2))
-        cscale.fini()
-    log(f"EP scaling (PTG tasks/s by nb_cores, host cores="
-        f"{os.cpu_count()}): {scaling}")
+    # process-per-chip scaling (the framework's official scale-out unit:
+    # one OS process per chip, ranks meshed over TCP — launch.py). Thread
+    # counts beyond one measure only the GIL; real deployments add
+    # processes, so the scaling row is measured through the real launcher,
+    # barrier-aligned, aggregate = P*ntasks/max(rank wall).
+    try:
+        from parsec_tpu.launch import ep_scaling_rates
+        scaling = ep_scaling_rates((1, 2, 4), ntasks=ntasks)
+    except Exception as e:
+        log(f"process scaling row unavailable: {e}")
+        scaling = {1: round(tasks_per_sec)}
+    results["tasks_per_sec_by_procs"] = {str(k): v for k, v in
+                                         sorted(scaling.items())}
+    results["scaling_note"] = (
+        "real OS processes via launch.py, barrier-aligned, aggregate = "
+        f"P*ntasks/max(rank wall); host nproc={os.cpu_count()} "
+        "(container quota may exceed it — threads are GIL-bound either way, "
+        "processes are the deployment shape)")
+    log(f"EP scaling (tasks/s by processes, host cores={os.cpu_count()}): "
+        f"{scaling}")
+    persist("after scaling row")
 
     # per-dispatch protocol cost of this chip path (diagnostic: on the
     # tunneled chip this is ~1000x a local PJRT dispatch and bounds any
@@ -398,28 +580,51 @@ def main() -> None:
         y = tiny(y)
     dispatch_ms = (time.perf_counter() - t0) / 20 * 1e3
     log(f"chained dispatch cost: {dispatch_ms:.2f} ms/call")
+    results["dispatch_ms"] = round(dispatch_ms, 3)
+    persist("before captured POTRF subprocess")
 
-    print(json.dumps({
-        "metric": "tiled-gemm-gflops",
-        "value": round(gflops, 1),
-        "unit": "GFLOP/s",
-        "platform": devs[0].platform,
-        "gemm_dtype": jnp.dtype(bench_dtype).name,
-        "timing": "slope+forced-barrier",
-        "dispatch_ms": round(dispatch_ms, 3),
-        "vs_baseline": round(gflops / raw_gflops, 4),
-        "gemm_sched_gflops": round(sched_gflops, 1),
-        "gemm_captured_gflops": round(cap_gflops, 1),
-        "potrf_gflops": round(potrf_gflops, 1),
-        "potrf_vs_baseline": round(potrf_gflops / raw_potrf_gflops, 4),
-        "potrf_sched_gflops": round(potrf_sched_gflops, 1),
-        "potrf_captured_gflops": round(potrf_cap_gflops, 1),
-        "tasks_per_sec": round(tasks_per_sec),
-        "dtd_insert_tasks_per_sec": round(dtd_rate),
-        "tasks_per_sec_by_cores": {str(k): v for k, v in scaling.items()},
-        "host_cores": os.cpu_count(),
-    }))
+    # ---- captured POTRF LAST, in a killable subprocess --------------------
+    # (round-3 postmortem: a timeout-killed captured-POTRF compile wedged
+    # the relay for the rest of the session; everything above is already
+    # persisted, and a wedge here cannot take the bench down with it)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--leg", "potrf-captured", "--platform", platform],
+            capture_output=True, text=True, timeout=900)
+        sys.stderr.write(p.stderr or "")
+        got = {}
+        for line in reversed((p.stdout or "").strip().splitlines()):
+            try:
+                got = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if p.returncode == 0 and got:
+            results.update(got)
+            results["potrf_gflops"] = round(
+                max(potrf_sched_gflops, got["potrf_captured_gflops"]), 1)
+            results["potrf_vs_baseline"] = round(
+                results["potrf_gflops"] / raw_potrf_gflops, 4)
+        else:
+            results["potrf_captured_error"] = \
+                f"rc={p.returncode}: {(p.stderr or '').strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        results["potrf_captured_error"] = "timeout(900s): subprocess killed"
+        log("captured POTRF leg timed out; continuing with persisted results")
+    persist("complete")
+
+    print(json.dumps(results))
 
 
 if __name__ == "__main__":
-    main()
+    if "--leg" in sys.argv:
+        leg = sys.argv[sys.argv.index("--leg") + 1]
+        plat = sys.argv[sys.argv.index("--platform") + 1] \
+            if "--platform" in sys.argv else ""
+        if leg == "potrf-captured":
+            potrf_captured_leg(plat)
+        else:
+            raise SystemExit(f"unknown leg {leg}")
+    else:
+        main()
