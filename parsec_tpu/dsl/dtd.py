@@ -426,9 +426,17 @@ class DTDTaskpool(Taskpool):
     def _link_tile(self, task: DTDTask, tile: DTDTile, acc: int,
                    flow_index: int, remote: bool, distributed: bool) -> None:
         if acc & NOTRACK:
-            # untracked access: the value still reaches the body through
-            # _prepare_input's newest_copy resolution, but no chaining, no
-            # version bump, no comm bookkeeping, no audit entry
+            # untracked access: no chaining, no version bump, no comm
+            # bookkeeping, no audit entry — and the VALUE is snapshotted NOW
+            # (ref: insert_function.c:3038 captures tile->data_copy at insert
+            # time): an untracked flow has no ordering edges, so resolving
+            # newest_copy at execution would let the body observe a tracked
+            # write that landed after this insertion
+            copy = tile.data.newest_copy()
+            if copy is not None:
+                if task.pending_inputs is None:
+                    task.pending_inputs = {}
+                task.pending_inputs[flow_index] = copy.payload
             return
         my = self.ctx.my_rank
         preds: List[DTDTask] = []

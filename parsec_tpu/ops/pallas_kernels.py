@@ -406,10 +406,12 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
     ``q_offset``/``k_offset`` give the global position of element 0 so the
     causal mask stays correct; fully-masked rows return zeros). Inside a
     ``shard_map``, pass ``vma=(axis, ...)`` so the output is typed as
-    device-varying. Falls back to the XLA expression of the same math on
-    any Pallas failure raised at trace/call time — a Mosaic error
-    surfacing later, at an OUTER jit's compile, is out of reach by design;
-    :func:`verify_lowering` is the gate for that class."""
+    device-varying. Sequence lengths not divisible by the block sizes
+    shrink the blocks to the largest divisor (a caller-shape property,
+    handled here — never a silent fallback). The XLA fallback is reserved
+    for Pallas LOWERING/runtime failures raised at trace/call time — a
+    Mosaic error surfacing later, at an OUTER jit's compile, is out of
+    reach by design; :func:`verify_lowering` is the gate for that class."""
     import jax.numpy as jnp
     q4 = q.reshape((-1,) + q.shape[-2:])
     k4 = k.reshape((-1,) + k.shape[-2:])
@@ -418,12 +420,19 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
     sk = k4.shape[1]
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    # block sizes must divide the sequence lengths — that is a property of
+    # the CALLER's shapes, not a Pallas failure, so resolve it here by
+    # shrinking to the largest divisor (never silently fall back over it):
+    # an odd length degrades the block size, not the numerics
+    def _divisor_block(s: int, b: int) -> int:
+        b = min(b, s)
+        while s % b:
+            b -= 1
+        return b
+
+    bq = _divisor_block(sq, block_q)
+    bk = _divisor_block(sk, block_k)
     try:
-        if sq % bq or sk % bk:
-            raise ValueError(f"seq lengths ({sq}, {sk}) not divisible by "
-                             f"blocks ({bq}, {bk})")
         out = _flash_attn_call(bhn, sq, sk, d, bq, bk, bool(causal),
                                float(scale), int(q_offset), int(k_offset),
                                str(q.dtype), _interpret(),

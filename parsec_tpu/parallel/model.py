@@ -138,7 +138,10 @@ def _decode_block(bp, x, ck, cv, pos, scale):
     return x + h @ bp["w2"] + bp["b2"], ck, cv
 
 
-@functools.lru_cache(maxsize=None)
+# bounded: every distinct (prompt_len, n_tokens, ...) pins a compiled
+# program incl. its device buffers, so varied-length generation must
+# recompile past the bound instead of leaking executables without limit
+@functools.lru_cache(maxsize=16)
 def _compiled_generate(n_layers: int, prompt_len: int, n_tokens: int,
                        greedy: bool, temperature: float):
     import jax
@@ -275,8 +278,11 @@ def lm_pp_forward(params: dict, tokens, mesh=None,
 
     x = params["embed"][tokens] + params["pos"][:S][None]
     xs = x.reshape(m, B // m, S, x.shape[-1])
+    # replicate_out=False: at LM scale the (B, S, D) activations stay
+    # resident on the last stage instead of riding a psum to every stage;
+    # the head below reads them where they were produced
     out = pipeline_forward_stages(stage_params, xs, stage_fn, mesh=mesh,
-                                  n_micro=m)
+                                  n_micro=m, replicate_out=False)
     h = _ln(out.reshape(B, S, -1), params["lnf_g"], params["lnf_b"])
     return jnp.einsum("bsd,vd->bsv", h, params["embed"],
                       preferred_element_type=jnp.float32)

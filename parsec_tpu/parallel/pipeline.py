@@ -55,7 +55,8 @@ def _mlp_stage(sp, x):
 
 
 @functools.lru_cache(maxsize=None)
-def _pipe_stages_call(mesh, n_micro: int, stage_fn: Callable):
+def _pipe_stages_call(mesh, n_micro: int, stage_fn: Callable,
+                      replicate_out: bool = True):
     """The (M + P - 1)-tick GPipe schedule for an ARBITRARY stage pytree
     (leading axis = stage) and stage function
     ``stage_fn(stage_params, act) -> act`` — e.g. a group of transformer
@@ -97,9 +98,14 @@ def _pipe_stages_call(mesh, n_micro: int, stage_fn: Callable):
 
         (act, out), _ = jax.lax.scan(tick, (act, out),
                                      jnp.arange(n_micro + nP - 1))
-        # outputs live on the last stage only: everyone else holds zeros,
-        # one psum replicates them (tiny shapes; fine for validation/driver)
-        return jax.lax.psum(jnp.where(idx == nP - 1, out, 0.0), axis)
+        if replicate_out:
+            # outputs live on the last stage only: everyone else holds
+            # zeros, one psum replicates them. O(P·B·S·D) redundant ICI
+            # traffic — acceptable for validation shapes, NOT at LM scale;
+            # pass replicate_out=False to keep them resident where the
+            # last stage computed them
+            return jax.lax.psum(jnp.where(idx == nP - 1, out, 0.0), axis)
+        return out          # stage-local: only the last stage's block is real
 
     def spec_of(leaf):
         return P(axis, *([None] * (leaf.ndim - 1)))
@@ -112,8 +118,9 @@ def _pipe_stages_call(mesh, n_micro: int, stage_fn: Callable):
         fn = jitted.get(key)
         if fn is None:
             in_specs = (jax.tree_util.tree_map(spec_of, sp), P())
+            out_spec = P() if replicate_out else P(axis)
             fn = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
-                                   out_specs=P()))
+                                   out_specs=out_spec))
             jitted[key] = fn
         return fn(sp, xs)
 
@@ -121,13 +128,21 @@ def _pipe_stages_call(mesh, n_micro: int, stage_fn: Callable):
 
 
 def pipeline_forward_stages(stage_params, x, stage_fn, mesh=None,
-                            n_micro: Optional[int] = None):
+                            n_micro: Optional[int] = None,
+                            replicate_out: bool = True):
     """GPipe over an arbitrary stage pytree: every leaf of
     ``stage_params`` has leading axis P (stage-major); device i runs
     ``stage_fn(stage_i_params, act)``. ``x``: (n_micro, B, ...)
     microbatches; returns the same shape. ``stage_fn`` must be a STABLE
     function object (module-level or cached) — it keys the compiled
-    program cache."""
+    program cache.
+
+    ``replicate_out=True`` (default) replicates the result to every stage
+    with a psum — O(P·activations) ICI traffic, fine for validation
+    shapes. ``replicate_out=False`` keeps the result SHARDED over the
+    stage axis (only the last stage's shard is live), so downstream
+    consumers (the LM head) read it where it was produced instead of
+    paying a full replication every forward."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -142,13 +157,20 @@ def pipeline_forward_stages(stage_params, x, stage_fn, mesh=None,
     assert m <= xs.shape[0], \
         f"n_micro={m} exceeds the {xs.shape[0]} provided microbatches"
     xs = xs[:m]        # honor the (n_micro, B, ...) return contract exactly
-    run = _pipe_stages_call(mesh, m, stage_fn)
+    run = _pipe_stages_call(mesh, m, stage_fn, replicate_out)
     sp = jax.tree_util.tree_map(
         lambda l: jax.device_put(
             l, NamedSharding(mesh, P(axis, *([None] * (l.ndim - 1))))),
         stage_params)
     xd = jax.device_put(xs, NamedSharding(mesh, P()))
-    return run(sp, xd)
+    res = run(sp, xd)
+    if not replicate_out:
+        # global shape (P·m, B, ...): block s is stage s's residue; only
+        # the LAST block carries the pipeline's output. The slice is lazy
+        # over the sharded array — it addresses the last stage's shard
+        # without replicating the others
+        res = res[(nP - 1) * m:]
+    return res
 
 
 def pipeline_forward(params, x, mesh=None, n_micro: Optional[int] = None):

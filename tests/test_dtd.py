@@ -200,9 +200,11 @@ def test_notrack_flag_skips_dependency_chaining(ctx):
     tp.close()
     ctx.wait()
     # both writes landed, in an UNDEFINED order (that is the NOTRACK
-    # contract): (5+1)*2 = 12 or 5*2+1 = 11
+    # contract). UW's input is snapshotted at INSERT time (ref
+    # insert_function.c:3038): 5 if W hadn't executed yet, 6 if it had —
+    # so the final value is 5*2=10 or 5+1=6-overwritten orders: {10, 11, 12}
     val = float(np.asarray(A.data_of(0, 0).newest_copy().payload)[0, 0])
-    assert val in (11.0, 12.0), val
+    assert val in (10.0, 11.0, 12.0), val
 
 
 def test_notrack_value_reaches_body(ctx):
@@ -220,6 +222,25 @@ def test_notrack_value_reaches_body(ctx):
     tp.close()
     ctx.wait()
     assert np.allclose(B.to_dense(), 3.0)
+
+
+def test_notrack_snapshots_value_at_insert(ctx):
+    """ref insert_function.c:3038: the untracked flow's value is captured at
+    insert_task time, not at execution — a tracked write that lands between
+    insertion and execution is invisible to the untracked reader."""
+    from parsec_tpu.dsl.dtd import NOTRACK
+    A = TiledMatrix("Ants", 8, 8, 8, 8)
+    A.fill(lambda m, n: np.full((8, 8), 5.0, np.float32))
+    seen = []
+    tp = DTDTaskpool(ctx, "notrack-snap")
+    t = tp.tile_of(A, 0, 0)
+    tp.insert_task(lambda a: a + 1.0, (t, RW), name="W")
+    tp.insert_task(lambda a: seen.append(float(np.asarray(a)[0, 0])),
+                   (t, READ | NOTRACK), jit=False, name="U")
+    tp.wait()
+    tp.close()
+    ctx.wait()
+    assert seen == [5.0], seen     # pre-W snapshot, even if W ran first
 
 
 def test_notrack_does_not_steer_placement(ctx):
