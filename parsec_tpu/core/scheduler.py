@@ -34,6 +34,7 @@ import bisect
 import heapq
 import itertools
 import random
+import sys
 import threading
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -79,7 +80,8 @@ class _LockedDeque:
     single collections.deque call, which CPython guarantees atomic under
     the GIL (append/extend/popleft/pop). Emptiness is handled by catching
     IndexError instead of check-then-act — the name is kept for its role
-    (the reference's parsec_dequeue, which does lock)."""
+    (the reference's parsec_dequeue, which does lock). On free-threaded
+    interpreters the module swaps in :class:`_ExplicitLockedDeque` below."""
 
     __slots__ = ("dq",)
 
@@ -106,6 +108,48 @@ class _LockedDeque:
 
     def __len__(self) -> int:
         return len(self.dq)
+
+
+class _ExplicitLockedDeque:
+    """Lock-based deque with the same surface as :class:`_LockedDeque`, for
+    free-threaded CPython (PEP 703, 3.13t+) where the GIL atomicity the
+    no-lock variant relies on is gone."""
+
+    __slots__ = ("dq", "lock")
+
+    def __init__(self) -> None:
+        self.dq: deque = deque()
+        self.lock = threading.Lock()
+
+    def push_front(self, items) -> None:
+        with self.lock:
+            self.dq.extendleft(reversed(items))
+
+    def push_back(self, items) -> None:
+        with self.lock:
+            self.dq.extend(items)
+
+    def pop_front(self):
+        with self.lock:
+            try:
+                return self.dq.popleft()
+            except IndexError:
+                return None
+
+    def pop_back(self):
+        with self.lock:
+            try:
+                return self.dq.pop()
+            except IndexError:
+                return None
+
+    def __len__(self) -> int:
+        return len(self.dq)
+
+
+# checked once at import — the interpreter cannot change GIL mode mid-process
+if not getattr(sys, "_is_gil_enabled", lambda: True)():  # pragma: no cover
+    _LockedDeque = _ExplicitLockedDeque  # noqa: F811
 
 
 class _LockedHeap:

@@ -117,3 +117,51 @@ def test_untied_tasks_insert_from_body():
     ctx.fini()
     assert len(spawned) == 3
     assert np.allclose(np.asarray(t.data.newest_copy().payload), 4.0)
+
+
+def test_explicit_locked_deque_multithreaded():
+    """The free-threading fallback deque (_ExplicitLockedDeque, selected
+    automatically when the GIL is off) keeps every element exactly once
+    under concurrent push/pop from both ends."""
+    import threading
+
+    from parsec_tpu.core.scheduler import _ExplicitLockedDeque, _LockedDeque
+
+    # same surface as the GIL-atomic variant
+    assert {m for m in dir(_LockedDeque) if not m.startswith("__")} <= \
+        set(dir(_ExplicitLockedDeque))
+
+    dq = _ExplicitLockedDeque()
+    N, W = 2000, 4
+    popped = [[] for _ in range(W)]
+
+    def producer(base):
+        for i in range(N):
+            (dq.push_front if i % 2 else dq.push_back)([base + i])
+
+    def consumer(out):
+        misses = 0
+        while misses < 3:
+            item = dq.pop_front() if len(out) % 2 else dq.pop_back()
+            if item is None:
+                misses += 1
+                continue
+            out.append(item)
+
+    prods = [threading.Thread(target=producer, args=(w * N,))
+             for w in range(W)]
+    cons = [threading.Thread(target=consumer, args=(popped[w],))
+            for w in range(W)]
+    for t in prods + cons:
+        t.start()
+    for t in prods:
+        t.join(timeout=30)
+    for t in cons:
+        t.join(timeout=30)
+    while True:            # drain anything the consumers gave up on
+        item = dq.pop_front()
+        if item is None:
+            break
+        popped[0].append(item)
+    got = sorted(x for out in popped for x in out)
+    assert got == list(range(W * N))
