@@ -1,0 +1,188 @@
+"""Direct class-primitive unit tests: futures, datacopy futures, info slots.
+
+Mirrors the reference's class-level batteries (tests/class/future.c,
+tests/class/future_datacopy.c, info registration in parsec/class/info.h)
+rather than exercising these types only through reshape/taskpool paths:
+single-assignment and callback ordering, countdown combination, the
+trigger-exactly-once datacopy promise under thread contention, and the
+process-wide info slot registry.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.futures import CountdownFuture, DataCopyFuture, Future
+from parsec_tpu.utils.info import InfoBag, InfoRegistry
+
+
+# ------------------------------------------------------------------ Future
+
+def test_future_single_assignment_and_callbacks():
+    f = Future()
+    seen = []
+    f.on_ready(seen.append)            # registered before completion
+    assert not f.ready
+    f.set(42)
+    assert f.ready and f.get() == 42
+    f.on_ready(seen.append)            # registered after completion
+    assert seen == [42, 42]
+    with pytest.raises(RuntimeError, match="already completed"):
+        f.set(43)
+
+
+def test_future_get_blocks_until_set_across_threads():
+    f = Future()
+    vals = []
+
+    def consumer():
+        vals.append(f.get(timeout=10))
+
+    ts = [threading.Thread(target=consumer) for _ in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.02)
+    f.set("payload")
+    for t in ts:
+        t.join(timeout=10)
+    assert vals == ["payload"] * 4
+
+
+def test_future_timeout_and_progress_pump():
+    f = Future()
+    with pytest.raises(TimeoutError):
+        f.get(timeout=0.05)
+    # the progress callable is pumped while waiting, so a single-threaded
+    # runtime can fulfil its own future from inside the wait loop
+    pumps = []
+
+    def progress():
+        pumps.append(1)
+        if len(pumps) == 3:
+            f.set("pumped")
+
+    assert f.get(timeout=5, progress=progress) == "pumped"
+    assert len(pumps) == 3
+
+
+# --------------------------------------------------------- CountdownFuture
+
+def test_countdown_future_combines_contributions():
+    f = CountdownFuture(3, combine=lambda a, b: a + b)
+    f.contribute(5)
+    f.contribute(7)
+    assert not f.ready
+    f.contribute(30)
+    assert f.ready and f.get() == 42
+
+
+def test_countdown_future_threaded_contributions():
+    n = 32
+    f = CountdownFuture(n, combine=lambda a, b: a + b)
+    ts = [threading.Thread(target=f.contribute, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert f.ready and f.get() == sum(range(n))
+
+
+# ---------------------------------------------------------- DataCopyFuture
+
+class _FakeCopy:
+    def __init__(self, payload):
+        self.payload = payload
+        self.released = 0
+
+    def release(self):
+        self.released += 1
+
+
+def test_datacopy_future_trigger_runs_exactly_once_under_contention():
+    """The reshape-promise contract (ref future_datacopy.c): many consumers
+    race request(); the conversion trigger runs once and every consumer
+    observes the SAME converted copy."""
+    src = _FakeCopy(np.arange(16, dtype=np.float32))
+    calls = []
+
+    def trigger(src_copy, spec):
+        calls.append(spec)
+        time.sleep(0.01)               # widen the race window
+        return _FakeCopy(src_copy.payload.reshape(spec))
+
+    fut = DataCopyFuture(src, (4, 4), trigger)
+    got = []
+
+    def consumer():
+        got.append(fut.request())
+
+    ts = [threading.Thread(target=consumer) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(calls) == 1             # trigger ran exactly once
+    assert all(g is got[0] for g in got)
+    assert got[0].payload.shape == (4, 4)
+
+
+def test_datacopy_future_release_drops_reference():
+    src = _FakeCopy(np.zeros(4))
+    fut = DataCopyFuture(src, None, lambda c, s: _FakeCopy(c.payload))
+    fut.release()                      # before trigger: nothing to drop
+    out = fut.request()
+    fut.release()
+    fut.release()
+    assert out.released == 2
+
+
+# ------------------------------------------------------------- info slots
+
+def test_info_registry_idempotent_ids_and_lookup():
+    reg = InfoRegistry()
+    a = reg.register("sched::spray")
+    b = reg.register("device::load")
+    assert a != b
+    assert reg.register("sched::spray") == a     # idempotent
+    assert reg.lookup("device::load") == b
+    assert reg.lookup("missing") is None
+    reg.unregister("sched::spray")
+    assert reg.lookup("sched::spray") is None
+
+
+def test_info_bag_sparse_slots():
+    reg = InfoRegistry()
+    bag = InfoBag()
+    hi = reg.register("x")
+    for _ in range(7):                 # ids grow; bag must autosize
+        hi = reg.register(f"slot{hi}")
+    bag.set(hi, "v")
+    assert bag.get(hi) == "v"
+    assert bag.get(0, default="d") == "d"        # unset low slot
+    assert bag.get(hi + 100, default="d") == "d"  # beyond storage
+    bag.set(0, 11)
+    assert bag.get(0) == 11
+
+
+def test_info_registry_threaded_registration_unique_ids():
+    reg = InfoRegistry()
+    ids = {}
+    lock = threading.Lock()
+
+    def worker(w):
+        for i in range(50):
+            iid = reg.register(f"name{i}")
+            with lock:
+                ids.setdefault(f"name{i}", set()).add(iid)
+
+    ts = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    # every name got exactly one id, and ids are distinct across names
+    assert all(len(v) == 1 for v in ids.values())
+    all_ids = [next(iter(v)) for v in ids.values()]
+    assert len(set(all_ids)) == len(all_ids)
