@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Tracer-overhead micro-benchmark: the sp-perf role.
+
+Re-design of the reference's standalone profiler perf test
+(tests/profiling-standalone/sp-perf.c): how many events/second can the
+tracer record, with and without info blobs, how long a dump takes, and the
+per-event overhead a traced runtime pays. The events/sec number bounds how
+densely the runtime can afford to trace; the overhead row is what
+``--mca profile_enabled`` costs each task.
+
+Usage: python benchmarks/trace_perf.py [nevents]
+Prints one JSON line.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    from parsec_tpu.tools.trace_reader import read_pbp
+    from parsec_tpu.utils.trace import (EVENT_FLAG_END, EVENT_FLAG_POINT,
+                                        EVENT_FLAG_START, Profiling)
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    prof = Profiling()
+    k_plain, k_plain_end = prof.add_dictionary_keyword("bench::plain")
+    k_info, _ = prof.add_dictionary_keyword(
+        "bench::info", info_desc="src{i};dst{i};size{q}")
+    stream = prof.stream("bench-thread")
+
+    # --- plain POINT events (the sp-perf hot loop) -------------------------
+    t0 = time.perf_counter()
+    for i in range(n):
+        stream.trace(k_plain, i, 0, EVENT_FLAG_POINT)
+    plain_s = time.perf_counter() - t0
+
+    # --- begin/end pairs (what task tracing actually emits) ----------------
+    t0 = time.perf_counter()
+    for i in range(n // 2):
+        stream.trace(k_plain, i, 0, EVENT_FLAG_START)
+        stream.trace(k_plain_end, i, 0, EVENT_FLAG_END)
+    pair_s = time.perf_counter() - t0
+
+    # --- POINT events with a packed info blob ------------------------------
+    info = prof.pack_info("bench::info", src=1, dst=2, size=4096)
+    t0 = time.perf_counter()
+    for i in range(n):
+        stream.trace(k_info, i, 0, EVENT_FLAG_POINT, info)
+    info_s = time.perf_counter() - t0
+
+    # a FRESH pack per event (runtime call sites pack at trace time)
+    t0 = time.perf_counter()
+    for i in range(n // 10):
+        stream.trace(k_info, i, 0, EVENT_FLAG_POINT,
+                     prof.pack_info("bench::info", src=i, dst=i + 1,
+                                    size=i * 64))
+    pack_s = time.perf_counter() - t0
+
+    # --- dump + read-back throughput ---------------------------------------
+    total_events = len(stream.events)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "perf.pbp")
+        t0 = time.perf_counter()
+        prof.dump(path)
+        dump_s = time.perf_counter() - t0
+        size_b = os.path.getsize(path)
+        t0 = time.perf_counter()
+        trace = read_pbp(path)
+        read_s = time.perf_counter() - t0
+        assert sum(len(s["events"]) for s in trace.streams) == total_events
+
+    print(json.dumps({
+        "metric": "trace-events-per-sec",
+        "value": round(n / plain_s),
+        "unit": "events/s",
+        "events_per_sec_plain": round(n / plain_s),
+        "events_per_sec_pairs": round(n / pair_s),
+        "events_per_sec_info_prepacked": round(n / info_s),
+        "events_per_sec_info_packed": round((n // 10) / pack_s),
+        "overhead_ns_per_event": round(plain_s / n * 1e9, 1),
+        "dump_events_per_sec": round(total_events / dump_s),
+        "read_events_per_sec": round(total_events / read_s),
+        "dump_bytes": size_b,
+        "n_events": total_events,
+    }))
+
+
+if __name__ == "__main__":
+    main()

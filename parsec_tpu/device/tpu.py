@@ -249,7 +249,27 @@ class TPUDevice(DeviceModule):
         if getattr(self, "_prof_stream", None) is None:
             self._prof_stream = prof.stream(self.name)
             self._prof_keys = prof.add_dictionary_keyword(f"{self.name}::exec")
+            # memory-ledger events (the dbp2mem surface, tools/profiling/
+            # dbp2mem.c): every residency change is a POINT event carrying
+            # the post-change occupancy, rendered over time by
+            # parsec_tpu.tools.mem_view
+            self._mem_key = prof.add_dictionary_keyword(
+                f"{self.name}::mem", info_desc="resident{q};delta{q}")[0]
+            self._prof_ref = prof
+            self._mem_seq = 0
         return self._prof_stream
+
+    def _trace_mem(self, delta: int) -> None:
+        """Record a residency change (bytes) on the device's trace stream."""
+        ps = self._prof()
+        if ps is None or delta == 0:
+            return
+        from ..utils.trace import EVENT_FLAG_POINT
+        self._mem_seq += 1
+        ps.trace(self._mem_key, self._mem_seq, 0, EVENT_FLAG_POINT,
+                 self._prof_ref.pack_info(f"{self.name}::mem",
+                                          resident=self._resident_bytes,
+                                          delta=delta))
 
     def _submit_one(self, gt: TPUTask) -> None:
         task = gt.task
@@ -421,6 +441,7 @@ class TPUDevice(DeviceModule):
         self._resident_bytes += new_size - old_size
         self._lru_sizes[key] = new_size
         self._lru[key] = copy
+        self._trace_mem(new_size - old_size)
         if new_size != old_size or key not in self._lru_segs:
             # re-register on size change AND whenever the key has no live
             # segment (a past allocate() miss under pressure must not
@@ -445,13 +466,15 @@ class TPUDevice(DeviceModule):
                     and data.newest_copy() is copy:
                 self._stage_out(data, copy)   # dirty: write back first
             self._lru.pop(key)
-            self._resident_bytes -= self._lru_sizes.pop(key, 0)
+            freed = self._lru_sizes.pop(key, 0)
+            self._resident_bytes -= freed
             seg = self._lru_segs.pop(key, None)
             if seg is not None:
                 seg.free()
             copy.coherency_state = COHERENCY_INVALID
             copy.payload = None
             self.evictions += 1
+            self._trace_mem(-freed)
             return True
         return False
 

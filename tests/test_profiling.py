@@ -357,3 +357,86 @@ def test_live_counter_view(ctx, tmp_path):
     assert any("sched" in n or "task" in n for n in active), active
     out = view.render(str(tmp_path / "counters.png"))
     assert os.path.getsize(out) > 1000
+
+
+# ------------------------------------------------- memory-over-time (dbp2mem)
+
+def test_device_memory_events_and_mem_view(tmp_path):
+    """The dbp2mem pipeline (tools/profiling/dbp2mem.c role): a DAG under a
+    tight device budget emits ::mem residency POINT events; mem_view renders
+    timeline/summary/CSV/SVG, with evictions visible as negative deltas."""
+    from parsec_tpu.device.tpu import TPUDevice
+    from parsec_tpu.tools import mem_view
+    from parsec_tpu.utils import mca
+
+    mca.set("device_tpu_over_cpu", True)
+    ctx = Context(nb_cores=1)
+    try:
+        ctx.profiling = Profiling()
+        devs = [d for d in ctx.devices.devices if isinstance(d, TPUDevice)]
+        assert devs, "device module did not register over the host device"
+        dev = devs[0]
+        ts = 16
+        tile_b = ts * ts * 4
+        dev.set_budget(3 * tile_b, unit=tile_b)      # room for ~3 tiles
+
+        A = TiledMatrix("Amem", 8 * ts, ts, ts, ts)
+        rng = np.random.default_rng(77)
+        A.fill(lambda m, n: rng.standard_normal((ts, ts)).astype(np.float32))
+        tp = DTDTaskpool(ctx, "memtrace")
+        for m in range(8):                            # 8 tiles > 3-tile budget
+            tp.insert_task(lambda x: x * 2.0, (tp.tile_of(A, m, 0), RW))
+        tp.wait(timeout=60)
+        tp.close()
+        ctx.wait(timeout=30)
+        assert dev.evictions > 0                      # pressure exercised
+        path = ctx.profiling.dump(str(tmp_path / "mem.pbp"))
+    finally:
+        ctx.fini()
+        mca.params.unset("device_tpu_over_cpu")
+
+    trace = read_pbp(path)
+    rows = mem_view.memory_timeline(trace)
+    assert rows, "no ::mem events in the trace"
+    assert all(r["t"] >= 0 for r in rows)
+    assert any(r["delta"] > 0 for r in rows)          # stage-ins
+    assert any(r["delta"] < 0 for r in rows)          # evictions
+    # residency is the post-change occupancy: replaying deltas reproduces it
+    run = {}
+    for r in rows:
+        run[r["stream"]] = run.get(r["stream"], 0) + r["delta"]
+        assert run[r["stream"]] == r["resident"], r
+    # residency never exceeds budget + one in-flight tile
+    assert max(r["resident"] for r in rows) <= 4 * (16 * 16 * 4)
+
+    summ = mem_view.summarize(trace)
+    s = next(iter(summ.values()))
+    assert s["peak"] > 0 and s["allocated"] > s["freed"] - 1
+
+    csv = mem_view.to_csv(trace)
+    assert csv.splitlines()[0] == "t_seconds,stream,resident_bytes,delta_bytes"
+    assert len(csv.splitlines()) == len(rows) + 1
+    svg = mem_view.to_svg(trace)
+    assert svg.startswith("<svg") and "polyline" in svg
+
+    # CLI writes both artifacts
+    out_csv, out_svg = str(tmp_path / "m.csv"), str(tmp_path / "m.svg")
+    assert mem_view.main([path, "--csv", out_csv, "--svg", out_svg]) == 0
+    assert os.path.getsize(out_csv) > 0 and os.path.getsize(out_svg) > 0
+
+
+def test_trace_perf_bench_runs():
+    """The sp-perf analogue emits sane numbers (small n: smoke, not perf)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "trace_perf.py"),
+         "2000"], capture_output=True, text=True, timeout=110)
+    assert p.returncode == 0, p.stderr[-500:]
+    got = json.loads(p.stdout.strip().splitlines()[-1])
+    assert got["metric"] == "trace-events-per-sec"
+    assert got["value"] > 10_000                      # trivially exceeded
+    assert got["n_events"] == 2000 + 2 * (2000 // 2) + 2000 + 2000 // 10
+    assert got["dump_events_per_sec"] > 0 and got["read_events_per_sec"] > 0
