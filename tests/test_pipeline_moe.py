@@ -67,3 +67,80 @@ def test_moe_capacity_drops_tokens():
     # per source device only ONE token fits expert 0's buffer slice
     nonzero_rows = np.abs(np.asarray(out)).sum(axis=1) > 1e-9
     assert nonzero_rows.sum() == nP, nonzero_rows
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_moe_topk_matches_dense(k):
+    """Top-k routing with no-drop capacity equals the dense top-k routed
+    computation (gates renormalized over the k winners)."""
+    mesh = make_ep_mesh()
+    nP = mesh.devices.size
+    E, D, F = nP, 16, 32
+    T = 8 * nP
+    params = init_moe_params(11, E, D, F)
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((T, D)).astype(np.float32)
+    out, aux = moe_forward(params, x, mesh=mesh, k=k, return_aux=True)
+    ref = dense_reference(params, x, k=k)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    assert float(aux["dropped"]) == 0.0
+    # Switch aux loss: >= 1 always, == 1 only under perfect balance
+    assert float(aux["aux_loss"]) >= 1.0 - 1e-4
+
+
+def test_moe_topk_capacity_factor_counts_drops():
+    """A tight capacity factor drops overflow (token, choice) pairs, the
+    count is reported globally, and first choices beat second choices for
+    slots (choice-major priority)."""
+    mesh = make_ep_mesh()
+    nP = mesh.devices.size
+    D = 8
+    params = init_moe_params(3, nP, D, 16)
+    # bias ALL tokens' top-1 to expert 0 and top-2 to expert 1
+    params["router"] = np.zeros_like(params["router"])
+    params["router"][0, 0] = 10.0
+    params["router"][0, 1] = 5.0
+    x = np.ones((4 * nP, D), np.float32)
+    out, aux = moe_forward(params, x, mesh=mesh, k=2, capacity=1,
+                           return_aux=True)
+    # per source device: expert 0 takes ONE first-choice token, expert 1
+    # takes ONE second-choice token; 4 tokens * 2 choices = 8 routed pairs
+    # per device, 2 kept -> 6 dropped each
+    assert float(aux["dropped"]) == 6.0 * nP
+    nonzero_rows = np.abs(np.asarray(out)).sum(axis=1) > 1e-9
+    assert nonzero_rows.sum() == nP, nonzero_rows
+
+    # fair-share capacity factor: cf=1 with k=2, E=nP experts, T_loc=4
+    # tokens -> ceil(1*2*4/nP) slots; generous cf drops nothing
+    out2, aux2 = moe_forward(params, x, mesh=mesh, k=2,
+                             capacity_factor=float(nP), return_aux=True)
+    assert float(aux2["dropped"]) == 0.0
+    ref = dense_reference(params, x, k=2)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_aux_loss_balance_signal():
+    """The aux loss separates balanced from collapsed routing: uniform
+    logits sit near 1, a router that sends everything to one expert is
+    driven toward E."""
+    mesh = make_ep_mesh()
+    nP = mesh.devices.size
+    D = 8
+    T = 8 * nP
+    # all-ones tokens: router columns act directly as logits, so the bias
+    # below collapses routing for EVERY token
+    x = np.ones((T, D), np.float32)
+
+    balanced = init_moe_params(0, nP, D, 16)
+    balanced["router"] = np.zeros_like(balanced["router"])  # uniform probs
+    _, aux_b = moe_forward(balanced, x, mesh=mesh, return_aux=True)
+
+    collapsed = init_moe_params(0, nP, D, 16)
+    collapsed["router"] = np.zeros_like(collapsed["router"])
+    collapsed["router"][0, 0] = 100.0                       # all -> expert 0
+    _, aux_c = moe_forward(collapsed, x, mesh=mesh, return_aux=True)
+
+    assert abs(float(aux_b["aux_loss"]) - 1.0) < 0.2
+    assert float(aux_c["aux_loss"]) > 0.9 * nP
