@@ -401,6 +401,16 @@ class GraphCapture:
         self._tiles = []
         self._tile_ix = {}
 
+    def mesh_hlo(self) -> str:
+        """Compiled (post-GSPMD) HLO text of the last mesh execution — the
+        sharding-quality introspection surface: collective ops and their
+        shapes are visible here, so tests can assert communication volume
+        scales with tile halos, not whole matrices."""
+        if getattr(self, "_last_mesh_call", None) is None:
+            output.fatal("mesh_hlo: no mesh execution recorded")
+        jitted, args = self._last_mesh_call
+        return jitted.lower(*args).compile().as_text()
+
     # ------------------------------------------------------- mesh execution
     def execute_mesh(self, mesh, axis_names=None) -> None:
         """Compile the captured DAG into ONE GSPMD program over a device
@@ -547,6 +557,11 @@ class GraphCapture:
                     _program_cache.popitem(last=False)
             else:
                 _program_cache.move_to_end(sig)
+        # kept for sharding-quality introspection (mesh_hlo): jax caches
+        # the executable, so lowering these args again is trace-only cost
+        self._last_mesh_call = (jitted, (tuple(globals_in),
+                                         tuple(local_vals),
+                                         tuple(arr_vals)))
         out_globs, out_locs = jitted(tuple(globals_in), tuple(local_vals),
                                      tuple(arr_vals))
 

@@ -568,3 +568,61 @@ def test_auto_capture_picks_scan_above_threshold(ctx):
         assert mode_big == "scan" and v_big == 16.0
     finally:
         mca.set("capture_scan_threshold", old)
+
+
+# ------------------------------------------- mesh-capture sharding quality
+
+def _collective_ops(hlo: str):
+    """(op kind, result bytes) for every collective in compiled HLO text."""
+    import re
+    bytes_of = {"f32": 4, "f64": 8, "bf16": 2, "f16": 2, "s32": 4,
+                "u32": 4, "s8": 1, "u8": 1, "pred": 1}
+    out = []
+    for line in hlo.splitlines():
+        m = re.search(
+            r"=\s+(\w+)\[([\d,]*)\][^ ]*\s+"
+            r"(all-gather|all-reduce|collective-permute|all-to-all|"
+            r"reduce-scatter)", line)
+        if m:
+            el = 1
+            for d in m.group(2).split(","):
+                if d:
+                    el *= int(d)
+            out.append((m.group(3), el * bytes_of.get(m.group(1), 4)))
+    return out
+
+
+@pytest.mark.parametrize("n", [64, 128])
+def test_mesh_capture_collectives_scale_with_halo(ctx, n):
+    """Sharding quality of the GSPMD program wait_mesh compiles: every
+    collective moves tile-halo-sized data — no collective materializes a
+    whole matrix, and the largest transfer stays at tile granularity as
+    the matrix grows (communication scales with the halo, not O(N^2)
+    replication)."""
+    mesh = _mesh2d()
+    ts = 16
+    rng = np.random.default_rng(25)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A, B, C = _gemm_collections(f"hq{n}", n, ts, a, b)
+    cap = DTDTaskpool(ctx, f"hlo-gemm{n}", capture=True)
+    insert_gemm_tasks(cap, A, B, C, batch_k=True)
+    cap.wait_mesh(mesh)
+    hlo = cap._capture.mesh_hlo()
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(C.to_dense()), a @ b,
+                               rtol=1e-3, atol=1e-3)
+
+    colls = _collective_ops(hlo)
+    assert colls, "compiled mesh program has no collectives (unexpected " \
+                  "for a 2x4-sharded GEMM)"
+    tile_bytes = ts * ts * 4
+    matrix_bytes = n * n * 4
+    worst = max(by for _, by in colls)
+    # halo granularity: the largest single collective moves at most one
+    # tile (2x slack for fused pairs) — and NEVER a whole matrix
+    assert worst <= 2 * tile_bytes, \
+        f"largest collective moves {worst} B (> tile {tile_bytes} B)"
+    assert worst < matrix_bytes / 4, \
+        f"collective {worst} B is matrix-scale ({matrix_bytes} B)"
