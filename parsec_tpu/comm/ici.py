@@ -25,14 +25,15 @@ design mapped to the XLA/PJRT execution model:
   stage-in takes the version-match fast path: zero transfers on the
   consume side.
 
-Cross-host seam: on a multi-host pod the relocation hook
-(:attr:`ICICE.relocate`) is the single point to swap — PJRT's cross-host
-device transfer (``jax.device_put`` under multi-controller jax, or the
-``jax.experimental.transfer`` DMA API) has the same signature contract
-(payload, target device) -> payload-on-target. Everything else (protocol,
-landing, counters) is transport-agnostic. In this tree the hook's default
-covers single-controller meshes (all chips visible to one process), which
-is also what the 8-virtual-device test/dryrun environment provides.
+Cross-host: when the producer and consumer devices belong to DIFFERENT OS
+ranks (the one-process-per-host production shape), the device-native path
+is :mod:`parsec_tpu.comm.xhost` — a PJRT transfer server per rank; the
+TCP backend ships a rendezvous descriptor in the AM frame and the consumer
+pulls the buffer straight into its device memory (``--mca comm_device_mem
+1``; host-bounce fallback counted). Within one process this backend's
+relocation hook (:attr:`ICICE.relocate`) covers every visible chip with a
+plain PJRT D2D copy, which is what the 8-virtual-device test/dryrun
+environment provides.
 
 Counters (process-wide, :mod:`parsec_tpu.utils.counters`):
 

@@ -41,8 +41,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils import output
-from .engine import CommEngine, CAP_MULTITHREADED, CAP_STREAMING
+from ..utils import mca, output
+from .engine import (CommEngine, CAP_ACCELERATOR_MEM, CAP_MULTITHREADED,
+                     CAP_STREAMING)
+# module-level: registers the comm_device_mem MCA param so the
+# PARSEC_MCA_comm_device_mem env layer resolves (an unregistered param
+# ignores the environment), and keeps XHostRef out of the progress hot path
+from .xhost import XHostRef, XHostTransfer
 
 _LEN = struct.Struct("!I")
 
@@ -50,6 +55,7 @@ _LEN = struct.Struct("!I")
 _KIND_AM = 0
 _KIND_BAR = 1        # barrier arrival (sent to rank 0)
 _KIND_BAR_REL = 2    # barrier release (rank 0 -> all)
+_KIND_XACK = 4       # cross-host pull complete: producer may retire the pin
 _KIND_BYE = 3        # clean shutdown notice (fini) — EOF after this is
                      # a normal departure, EOF without it is a FAILURE
 
@@ -148,6 +154,22 @@ class TCPCE(CommEngine):
         self._departed: set = set()   # ranks that said BYE (clean exits)
         self.sent_msgs = 0
         self.recv_msgs = 0
+        # cross-host device-payload plane (PJRT transfer server), gated by
+        # --mca comm_device_mem like the reference's GPU-comms flag
+        # (parsec_internal.h:504). _xhost gates the SEND side (None =
+        # host-bounce, counted); _xpull services incoming refs regardless,
+        # so a flag-off rank can pull from an enabled peer WITHOUT flipping
+        # its own sends to the device-mem path
+        self._xhost = None
+        self._xpull = None
+        if mca.get("comm_device_mem", False):
+            if XHostTransfer.available():
+                self._xhost = self._xpull = XHostTransfer()
+                self.capabilities |= CAP_ACCELERATOR_MEM
+            else:
+                output.warning("comm_device_mem requested but "
+                               "jax.experimental.transfer is unavailable; "
+                               "device payloads will host-bounce (counted)")
         # barrier state
         self._bar_lock = threading.Lock()
         self._bar_cv = threading.Condition(self._bar_lock)
@@ -268,6 +290,8 @@ class TCPCE(CommEngine):
                     with self._bar_cv:
                         self.dead_peers.add(rank)
                         self._bar_cv.notify_all()
+                    if self._xhost is not None:
+                        self._xhost.retire_peer(rank)   # its pulls never come
                 return
             kind = frame[0]
             if kind == _KIND_BYE:
@@ -277,6 +301,8 @@ class TCPCE(CommEngine):
                 with self._bar_cv:
                     self._departed.add(rank)
                     self._bar_cv.notify_all()
+                if self._xhost is not None:
+                    self._xhost.retire_peer(rank)   # clean exit: same deal
                 return
             if kind == _KIND_AM:
                 self._inbound.append(frame[1:])
@@ -290,6 +316,9 @@ class TCPCE(CommEngine):
                     self._bar_released[frame[1]] = \
                         (frame[2], frame[3]) if len(frame) > 3 else ([], [])
                     self._bar_cv.notify_all()
+            elif kind == _KIND_XACK:
+                if self._xhost is not None:
+                    self._xhost.retire(frame[1])
 
     # ------------------------------------------------------------ AM path
     def send_am(self, tag: int, dst: int, header: Any, payload: Any = None) -> None:
@@ -300,12 +329,24 @@ class TCPCE(CommEngine):
         meta, raw, inline = None, None, payload
         if payload is not None and hasattr(payload, "shape") \
                 and hasattr(payload, "dtype"):
+            is_device = type(payload).__module__.split(".")[0] \
+                not in ("numpy",)
+            if is_device and self._xhost is not None:
+                # device-native cross-rank path: register for PJRT pull,
+                # ship only the rendezvous descriptor in the wire frame —
+                # the buffer moves transfer-server-to-device on the
+                # consumer's pull (parsec_mpi_funnelled.c:642 role)
+                ref = self._xhost.offer(payload, dst=dst)
+                _send_frame(self._peers[dst], self._peer_locks[dst],
+                            (_KIND_AM, tag, self.my_rank, header, ref,
+                             None), None)
+                return
             # device arrays materialize host bytes HERE, at the wire
             # boundary — the protocol layer above never forces them.
             # Counted so the ICI backend's "zero host materializations"
             # property is assertable against this stream transport
             # (comm/ici.py docstring).
-            if type(payload).__module__.split(".")[0] not in ("numpy",):
+            if is_device:
                 from ..utils.counters import counters
                 counters.add("comm.host_materialized_msgs")
             a = np.ascontiguousarray(np.asarray(payload))
@@ -329,6 +370,22 @@ class TCPCE(CommEngine):
             except IndexError:
                 break
             self.recv_msgs += 1
+            if isinstance(payload, XHostRef):
+                # rendezvous envelope: pull the device buffer directly onto
+                # this rank's device through the PJRT transfer transport,
+                # then tell the producer to retire its pin
+                ref = payload
+                if self._xpull is None:     # pull-only handle: servicing a
+                    self._xpull = XHostTransfer()   # peer does NOT enable
+                payload = self._xpull.pull(ref)     # our own send path
+                try:
+                    _send_frame(self._peers[src], self._peer_locks[src],
+                                (_KIND_XACK, ref.uuid))
+                except OSError:
+                    # producer already gone (fini/crash): the payload is
+                    # ours; its pin dies with the producer's process or
+                    # its dead-peer retirement
+                    pass
             if not self._deliver(tag, src, header, payload):
                 output.debug_verbose(1, "tcp", f"dropped AM tag {tag}")
             n += 1
@@ -433,6 +490,8 @@ class TCPCE(CommEngine):
         for t in self._readers:
             t.join(timeout=2.0)
         self._peers.clear()
+        if self._xhost is not None:
+            self._xhost.clear()        # nothing will pull after goodbye
 
 
 # ---------------------------------------------------------------------------

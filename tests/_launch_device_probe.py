@@ -52,12 +52,44 @@ def main():
                for m in range(n//ts) for k in range(n//ts)
                if C.rank_of(m, k) == ce.my_rank), default=0.0)
     executed = sum(d.executed_tasks for d in tpus)
+
+    # cross-host device-payload leg: a DEVICE-resident array crosses the OS
+    # ranks through the PJRT transfer server (comm/xhost.py) — rendezvous
+    # descriptor in the AM frame, buffer pulled device-to-device, pin
+    # retired by the transport ACK
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from parsec_tpu.comm.engine import CAP_ACCELERATOR_MEM, TAG_DSL_BASE
+    from parsec_tpu.comm.xhost import XHostTransfer
+    from parsec_tpu.utils.counters import counters
+
+    xgot = []
+    ce.tag_register(TAG_DSL_BASE, lambda _c, src, hdr, pl: xgot.append(pl))
+    ce.sync()
+    ce._xhost = ce._xpull = XHostTransfer()
+    ce.capabilities |= CAP_ACCELERATOR_MEM
+    dev_payload = jnp.full((8, 8), float(ce.my_rank + 1))
+    ce.send_am(TAG_DSL_BASE, (ce.my_rank + 1) % ce.nb_ranks, {}, dev_payload)
+    t0 = time.time()
+    while (not xgot or ce._xhost.pending()) and time.time() - t0 < 30:
+        ce.progress()
+        time.sleep(0.001)
+    peer = (ce.my_rank - 1) % ce.nb_ranks
+    assert xgot and isinstance(xgot[0], jax.Array), xgot
+    assert float(np.asarray(xgot[0])[0, 0]) == float(peer + 1)
+    assert ce._xhost.pending() == 0          # ACK retired the pin
+    xd2d = int(counters.read("comm.xhost_d2d_msgs"))
+
     print(f"PROBE rank={ce.my_rank} devices={[d.jax_device.id for d in tpus]} "
-          f"executed={executed} err={err:.2e}", flush=True)
+          f"executed={executed} err={err:.2e} xhost_d2d={xd2d}", flush=True)
     ce.sync()
     ce.fini()
     assert err < 1e-3
     assert len(tpus) == 1 and executed > 0
+    assert xd2d == 1
 
 
 if __name__ == "__main__":

@@ -382,3 +382,110 @@ def test_tcp_clean_exit_mid_barrier_is_attributed():
     assert results[1] == "skipped"
     assert results[0] == "attributed", results[0]
     assert results[2] == "attributed", results[2]
+
+
+# --------------------------------------------- cross-host device payloads
+
+def _xhost_program(rank, ce):
+    """Two OS ranks: a DEVICE-resident payload crosses via the PJRT
+    transfer server (rendezvous descriptor in the AM frame, buffer pulled
+    device-to-device), a host numpy payload rides the wire as before, and
+    with the flag OFF the device payload host-bounces and is COUNTED."""
+    _force_cpu()
+    import time
+
+    import jax.numpy as jnp
+
+    from parsec_tpu.comm.engine import TAG_DSL_BASE
+    from parsec_tpu.utils.counters import counters
+
+    got = []
+    ce.tag_register(TAG_DSL_BASE,
+                    lambda _ce, src, hdr, pl: got.append((hdr, pl)))
+    ce.sync()
+
+    def exchange(tagval, payload):
+        got.clear()
+        dst = (rank + 1) % ce.nb_ranks
+        ce.send_am(TAG_DSL_BASE, dst, {"k": tagval}, payload)
+        t0 = time.time()
+        while not got and time.time() - t0 < 30:
+            ce.progress()
+            time.sleep(0.001)
+        assert got, f"no payload for {tagval}"
+        return got[0]
+
+    # device-resident: jax array (CPU backend stands in for the chip)
+    hdr, pl = exchange("dev", jnp.full((16, 16), float(rank + 1)))
+    ce.sync()
+    import jax
+    peer = (rank - 1) % ce.nb_ranks
+    if ce._xhost is not None:
+        # the pulled payload arrives DEVICE-resident on the consumer
+        assert isinstance(pl, jax.Array), type(pl)
+        # extended dtypes must survive the descriptor round-trip (dtype
+        # NAME, not .str which collapses bf16 to raw void)
+        hdrb, plb = exchange("bf16", jnp.full((8, 8), float(rank + 2),
+                                              jnp.bfloat16))
+        ce.sync()
+        assert plb.dtype == jnp.bfloat16, plb.dtype
+        assert float(np.asarray(plb.astype(jnp.float32))[0, 0]) == \
+            float(peer + 2)
+    assert float(np.asarray(pl)[0, 0]) == float(peer + 1)
+
+    # host numpy payload: unaffected by the device-mem plane
+    hdr2, pl2 = exchange("host", np.full((4,), rank, np.int32))
+    ce.sync()
+    assert int(pl2[0]) == peer
+
+    # wait for the peer's ACK to retire our pin (reader-thread async)
+    t0 = time.time()
+    while ce._xhost is not None and ce._xhost.pending() \
+            and time.time() - t0 < 20:
+        ce.progress()
+        time.sleep(0.002)
+    stats = {
+        "d2d": counters.read("comm.xhost_d2d_msgs"),
+        "offered": counters.read("comm.xhost_offered_msgs"),
+        "bounced": counters.read("comm.host_materialized_msgs"),
+        "pins": ce._xhost.pending() if ce._xhost is not None else -1,
+    }
+    ce.sync()
+    ce.fini()
+    return stats
+
+
+def _xhost_program_enabled(rank, ce):
+    from parsec_tpu.utils import mca
+    mca.set("comm_device_mem", True)
+    # the CE was built before the flag was set (run_distributed_procs
+    # constructs it); rebuild the xhost plane the way __init__ would
+    from parsec_tpu.comm.engine import CAP_ACCELERATOR_MEM
+    from parsec_tpu.comm.xhost import XHostTransfer
+    assert XHostTransfer.available()
+    ce._xhost = ce._xpull = XHostTransfer()
+    ce.capabilities |= CAP_ACCELERATOR_MEM
+    return _xhost_program(rank, ce)
+
+
+def test_tcp_xhost_device_payload_pull():
+    """comm_device_mem=1: device payloads cross OS ranks via PJRT pull —
+    zero host materializations, pins retired by the ACK."""
+    results = run_distributed_procs(2, _xhost_program_enabled, timeout=120)
+    for s in results:
+        assert s["offered"] == 2, s       # f32 + bf16 payloads offered
+        assert s["d2d"] == 2, s           # both pulled device-to-device
+        assert s["bounced"] == 0, s       # never host-materialized
+        assert s["pins"] == 0, s          # ACKs retired the pins
+
+
+def test_tcp_xhost_disabled_bounces_and_counts():
+    """Flag off (the default): the same device payload host-bounces into
+    the wire frame and the bounce is COUNTED — the measured-cost fallback
+    the design requires."""
+    results = run_distributed_procs(2, _xhost_program, timeout=120)
+    for s in results:
+        assert s["offered"] == 0, s
+        assert s["d2d"] == 0, s
+        assert s["bounced"] == 1, s       # counted fallback
+        assert s["pins"] == -1, s         # no xhost plane was built
