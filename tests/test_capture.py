@@ -626,3 +626,34 @@ def test_mesh_capture_collectives_scale_with_halo(ctx, n):
         f"largest collective moves {worst} B (> tile {tile_bytes} B)"
     assert worst < matrix_bytes / 4, \
         f"collective {worst} B is matrix-scale ({matrix_bytes} B)"
+
+
+def test_scan_capture_scales_to_hundreds_of_tasks(ctx):
+    """The round-3 pathology regression gate: an 816-task POTRF DAG under
+    the scan strategy compiles + runs in seconds (the inlined strategy
+    compiled superlinearly and ran 25-60x its op-sum on chip), and a
+    second DAG of the same geometry reuses the executable."""
+    import time
+
+    NT, ts = 16, 32
+    n = NT * ts
+    spd = make_spd(n, seed=3)
+    P = TwoDimBlockCyclic("scS", n, n, ts, ts, P=1, Q=1)
+    P.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    tp = DTDTaskpool(ctx, "scan-scale", capture="scan")
+    insert_potrf_tasks(tp, P)
+    t0 = time.perf_counter()
+    tp.wait()
+    first_s = time.perf_counter() - t0
+    assert not tp._capture.cache_hit
+    assert first_s < 60, f"compile+run took {first_s:.1f}s (blowup regressed)"
+
+    P.fill(lambda m, k: spd[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    insert_potrf_tasks(tp, P)
+    tp.wait()
+    assert tp._capture.cache_hit        # same classes/geometry: cached
+    tp.close()
+    ctx.wait(timeout=30)
+    L = np.tril(np.asarray(P.to_dense(), np.float64))
+    np.testing.assert_allclose(
+        L, np.linalg.cholesky(spd.astype(np.float64)), rtol=0, atol=1e-4)
