@@ -142,6 +142,31 @@ def main():
         print(f"E3 {name:14s}: compile {tc:5.1f}s  T1={t1*1e3:7.1f}ms "
               f"T3={t3*1e3:7.1f}ms  slope={(t3-t1)/2*1e3:7.1f} ms/DAG",
               flush=True)
+
+    # ---- E4: the FIX — scan strategy vs inline on the full DAG ------------
+    # (round 4: the scanned task-class interpreter keeps ONE instance per
+    # body class; compare both strategies head-to-head on the same DAG)
+    for strategy in ("inline", "scan"):
+        Pm.fill(lambda m, k: spd[m*pTS:(m+1)*pTS, k*pTS:(k+1)*pTS])
+
+        def run_s(n_dags):
+            tp = DTDTaskpool(ctx, f"cap4-{strategy}", capture=strategy)
+            t0 = time.perf_counter()
+            for _ in range(n_dags):
+                insert(tp, variants["full"])
+                tp.wait()
+            tp.close()
+            barrier()
+            return time.perf_counter() - t0
+
+        tc = time.perf_counter()
+        run_s(1)
+        tc = time.perf_counter() - tc
+        t1 = timed(lambda: run_s(1), reps=2)
+        t3 = timed(lambda: run_s(3), reps=2)
+        print(f"E4 {strategy:6s}: compile {tc:5.1f}s  T1={t1*1e3:7.1f}ms "
+              f"T3={t3*1e3:7.1f}ms  slope={(t3-t1)/2*1e3:7.1f} ms/DAG",
+              flush=True)
     ctx.fini()
 
 
