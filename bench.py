@@ -194,6 +194,70 @@ def potrf_captured_leg(platform: str) -> None:
     }))
 
 
+def gemm_big_leg(platform: str) -> None:
+    """TPU-only stretch leg (``--leg gemm-big``): captured tiled GEMM at
+    the harness-contract size N=16384 (BASELINE stretch: >=70% of bf16
+    peak at N>=16384; ref dtd_test_simple_gemm.c:1143-1161). Big H2D
+    transfers + a fresh compile over the relay are wedge-risky, so the
+    parent runs this in a killable subprocess after everything else is
+    safe on disk. Prints one mini JSON line."""
+    jax = setup_backend(platform)
+    import numpy as np
+    import jax.numpy as jnp
+    import parsec_tpu as pt
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    from parsec_tpu.ops.gemm import gemm_flops, insert_gemm_tasks
+
+    devs = jax.devices()
+    if devs[0].platform not in ("tpu", "axon"):
+        print(json.dumps({"gemm_big_skipped": "not on an accelerator"}))
+        return
+    N, TS = 16384, 4096
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((N, N)).astype(jnp.bfloat16)
+    b = rng.standard_normal((N, N)).astype(jnp.bfloat16)
+    A = TwoDimBlockCyclic("bigA", N, N, TS, TS, P=1, Q=1)
+    B = TwoDimBlockCyclic("bigB", N, N, TS, TS, P=1, Q=1)
+    C = TwoDimBlockCyclic("bigC", N, N, TS, TS, P=1, Q=1)
+    mt = N // TS
+    ctx = pt.Context(nb_cores=1)
+    fuse_all = jax.jit(
+        lambda ts: sum(t[0, 0].astype(jnp.float32) for t in ts))
+
+    def run(n_dags: int) -> float:
+        A.fill(lambda m, k: a[m*TS:(m+1)*TS, k*TS:(k+1)*TS])
+        B.fill(lambda m, k: b[m*TS:(m+1)*TS, k*TS:(k+1)*TS])
+        C.fill(lambda m, k: np.zeros((TS, TS), jnp.bfloat16))
+        tp = DTDTaskpool(ctx, "big-gemm", capture=True)
+        t0 = time.perf_counter()
+        for _ in range(n_dags):
+            insert_gemm_tasks(tp, A, B, C, batch_k=True)
+            tp.wait()
+        tp.close()
+        s = fuse_all([jnp.asarray(C.data_of(m, n).newest_copy().payload)
+                      for m in range(mt) for n in range(mt)])
+        np.asarray(jax.device_get(s))
+        return time.perf_counter() - t0
+
+    t_compile = time.perf_counter()
+    run(1)
+    t_compile = time.perf_counter() - t_compile
+    t_lo = min(run(1) for _ in range(2))
+    t_hi = min(run(3) for _ in range(2))
+    big_s = _slope(t_lo, t_hi, 1, 3, "big captured GEMM")
+    big_gflops = gemm_flops(N, N, N) / 1e9 / big_s
+    ctx.fini()
+    out = {"gemm_big_captured_gflops": round(big_gflops, 1),
+           "gemm_big_n": N, "gemm_big_ts": TS,
+           "gemm_big_compile_s": round(t_compile, 1)}
+    _, peak = detect_chip(getattr(devs[0], "device_kind", ""))
+    if peak:
+        out["gemm_big_pct_of_peak_bf16"] = round(
+            big_gflops / (peak * 1e3) * 100, 1)
+    print(json.dumps(out))
+
+
 def main() -> None:
     import numpy as np
 
@@ -583,35 +647,46 @@ def main() -> None:
     results["dispatch_ms"] = round(dispatch_ms, 3)
     persist("before captured POTRF subprocess")
 
-    # ---- captured POTRF LAST, in a killable subprocess --------------------
+    # ---- compile-risky legs LAST, each in a killable subprocess -----------
     # (round-3 postmortem: a timeout-killed captured-POTRF compile wedged
     # the relay for the rest of the session; everything above is already
     # persisted, and a wedge here cannot take the bench down with it)
-    try:
-        p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--leg", "potrf-captured", "--platform", platform],
-            capture_output=True, text=True, timeout=900)
-        sys.stderr.write(p.stderr or "")
-        got = {}
-        for line in reversed((p.stdout or "").strip().splitlines()):
-            try:
-                got = json.loads(line)
-                break
-            except ValueError:
-                continue
-        if p.returncode == 0 and got:
-            results.update(got)
-            results["potrf_gflops"] = round(
-                max(potrf_sched_gflops, got["potrf_captured_gflops"]), 1)
-            results["potrf_vs_baseline"] = round(
-                results["potrf_gflops"] / raw_potrf_gflops, 4)
-        else:
-            results["potrf_captured_error"] = \
-                f"rc={p.returncode}: {(p.stderr or '').strip()[-300:]}"
-    except subprocess.TimeoutExpired:
-        results["potrf_captured_error"] = "timeout(900s): subprocess killed"
-        log("captured POTRF leg timed out; continuing with persisted results")
+    def run_leg(leg: str, timeout_s: int) -> dict:
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--leg", leg, "--platform", platform],
+                capture_output=True, text=True, timeout=timeout_s)
+            sys.stderr.write(p.stderr or "")
+            for line in reversed((p.stdout or "").strip().splitlines()):
+                try:
+                    got = json.loads(line)
+                    if p.returncode == 0:
+                        return got
+                    break
+                except ValueError:
+                    continue
+            return {f"{leg}_error":
+                    f"rc={p.returncode}: {(p.stderr or '').strip()[-300:]}"}
+        except subprocess.TimeoutExpired:
+            log(f"{leg} leg timed out; continuing with persisted results")
+            return {f"{leg}_error": f"timeout({timeout_s}s): killed"}
+
+    got = run_leg("potrf-captured", 900)
+    results.update(got)
+    if "potrf_captured_gflops" in got:
+        results["potrf_gflops"] = round(
+            max(potrf_sched_gflops, got["potrf_captured_gflops"]), 1)
+        results["potrf_vs_baseline"] = round(
+            results["potrf_gflops"] / raw_potrf_gflops, 4)
+    persist("after captured POTRF subprocess")
+
+    if on_tpu:
+        # stretch leg: captured bf16 GEMM at the harness-contract N=16384.
+        # Reported under its own gemm_big_* keys (with pct-of-peak computed
+        # in the leg); the headline value/vs_baseline stay at N=8192 where
+        # the raw-XLA baseline ran on the same operands
+        results.update(run_leg("gemm-big", 1200))
     persist("complete")
 
     print(json.dumps(results))
@@ -624,6 +699,8 @@ if __name__ == "__main__":
             if "--platform" in sys.argv else ""
         if leg == "potrf-captured":
             potrf_captured_leg(plat)
+        elif leg == "gemm-big":
+            gemm_big_leg(plat)
         else:
             raise SystemExit(f"unknown leg {leg}")
     else:
