@@ -94,8 +94,11 @@ def _insert_all(tp, tiles, tasks):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
-@pytest.mark.parametrize("mode", ["sched1", "sched4", "capture"])
+@pytest.mark.parametrize("mode", ["sched1", "sched4", "capture", "scan"])
 def test_fuzz_single_rank(seed, mode):
+    """`scan` is the worst case for the task-class interpreter: random
+    per-op scalar constants make nearly every op its own class, so the
+    switch is as wide as the DAG — correctness must survive anyway."""
     tasks = random_dag(seed)
     ref = numpy_replay(tasks, _init)
     ctx = Context(nb_cores=4 if mode == "sched4" else 1)
@@ -103,7 +106,8 @@ def test_fuzz_single_rank(seed, mode):
         A = TiledMatrix(f"F{mode}{seed}", NT * TS, TS, TS, TS)
         A.fill(lambda m, n: _init(m))
         tp = DTDTaskpool(ctx, f"fuzz-{mode}-{seed}",
-                         capture=(mode == "capture"))
+                         capture=(mode if mode == "scan"
+                                  else mode == "capture"))
         tiles = [tp.tile_of(A, i, 0) for i in range(NT)]
         _insert_all(tp, tiles, tasks)
         tp.wait()
