@@ -37,6 +37,10 @@ def main(argv=None) -> int:
                          "rank i to device i%%N through the TPU device module "
                          "— the production process-per-rank/chip-per-process "
                          "shape, rehearsed without chips")
+    ap.add_argument("--mca", nargs=2, action="append", default=[],
+                    metavar=("PARAM", "VALUE"),
+                    help="set an MCA parameter in every rank (exported as "
+                         "PARSEC_MCA_<param>; the mpirun --mca role)")
     ap.add_argument("script")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     opts = ap.parse_args(argv)
@@ -66,6 +70,8 @@ def main(argv=None) -> int:
         env[ENV_RANK] = str(rank)
         env[ENV_NPROCS] = str(opts.nprocs)
         env[ENV_RDV] = rdv
+        for pname, pval in opts.mca:
+            env["PARSEC_MCA_" + pname] = pval
         if opts.virtual_devices:
             # rehearse the chip-per-process shape over virtual CPU devices
             n = opts.virtual_devices

@@ -7,7 +7,8 @@ import sys
 
 import pytest
 
-EXAMPLES = [f"ex0{i}" for i in range(9)] + ["ex10", "ex11", "ex12", "ex13"]
+EXAMPLES = [f"ex0{i}" for i in range(9)] + ["ex10", "ex11", "ex12", "ex13",
+                                            "ex14"]
 EX_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                       "examples")
 
@@ -28,6 +29,18 @@ def test_example_tcp_launch():
     out = subprocess.run(
         [sys.executable, "-m", "parsec_tpu.launch", "-n", "2", "--cpu",
          os.path.join("examples", fname)],
+        cwd=os.path.dirname(EX_DIR), env=env,
+        capture_output=True, text=True, timeout=200)
+    assert out.returncode == 0, out.stderr[-2000:]
+
+
+def test_example_device_mem_comms():
+    """Ex14: device-native cross-rank payloads via the launcher's --mca."""
+    fname = "ex14_device_mem_comms.py"
+    env = dict(os.environ, EXAMPLES_CPU="1")
+    out = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.launch", "-n", "2", "--cpu",
+         "--mca", "comm_device_mem", "1", os.path.join("examples", fname)],
         cwd=os.path.dirname(EX_DIR), env=env,
         capture_output=True, text=True, timeout=200)
     assert out.returncode == 0, out.stderr[-2000:]
