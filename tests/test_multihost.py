@@ -1,0 +1,50 @@
+"""Multi-controller SPMD: the true multi-host shape, rehearsed with real
+OS processes.
+
+Two controller processes x 4 virtual CPU devices join ONE jax job
+(`jax.distributed.initialize`): `jax.devices()` is global, the (dp, tp)
+mesh spans both processes, and the flagship LM train step's collectives
+cross the process boundary (Gloo here; ICI/DCN on a pod). The reference
+reaches this scale through mpirun + NCCL/MPI; here the ENTIRE data plane
+is XLA collectives — the framework layer only brings the job up.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from parsec_tpu.parallel.multihost import run_multicontroller
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _losses(out: str):
+    m = re.search(r"MHLOSS pid=\d+ losses=([\d.,-]+)", out)
+    assert m, f"no MHLOSS line in:\n{out[-1200:]}"
+    return [float(v) for v in m.group(1).split(",")]
+
+
+def test_two_controller_global_mesh_lm_train_step():
+    outs = run_multicontroller(
+        2, os.path.join(REPO, "tests", "_multihost_worker.py"),
+        devices_per_proc=4)
+    l0, l1 = _losses(outs[0]), _losses(outs[1])
+    # every controller observes the SAME replicated losses (one global
+    # program, not two independent runs)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6, atol=1e-6)
+    assert l0[-1] < l0[0]                   # it actually trains
+    # ring attention's K/V ring crossed the process boundary; each
+    # controller validated ITS sequence span against the dense reference
+    spans = sorted(re.search(r"MHRING pid=\d+ err=[\d.e-]+ span=(\d+):(\d+)",
+                             o).groups() for o in outs)
+    assert spans == [("0", "32"), ("32", "64")], spans
+
+    # and the global 2-process run computes the SAME numbers as one
+    # process with the same 8-device mesh: the mesh is the program, the
+    # process boundary is invisible
+    ref = run_multicontroller(
+        1, os.path.join(REPO, "tests", "_multihost_worker.py"),
+        devices_per_proc=8)
+    np.testing.assert_allclose(_losses(ref[0]), l0, rtol=2e-5, atol=2e-5)
