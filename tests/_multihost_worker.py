@@ -22,13 +22,15 @@ def main():
                                            make_lm_train_step)
 
     import os
-    mesh = global_mesh(("dp", "tp"), (2, 4))
-    assert len(jax.devices()) == 8
+    n = len(jax.devices())
+    assert n >= 4 and n % 2 == 0, f"need an even mesh, got {n} devices"
+    tp = n // 2
+    mesh = global_mesh(("dp", "tp"), (2, tp))
     if os.environ.get("PARSEC_TPU_NUM_PROCESSES", "1") != "1":
-        assert len(jax.local_devices()) == 4    # the rest are the peer's
+        assert len(jax.local_devices()) < n     # the rest are the peers'
 
-    cfg = ModelConfig(vocab_size=64, d_model=32, d_ff=64, n_heads=4,
-                      n_layers=2, max_seq=16)
+    cfg = ModelConfig(vocab_size=64, d_model=32, d_ff=64,
+                      n_heads=max(4, tp), n_layers=2, max_seq=16)
     params = init_lm_params(0, cfg)          # identical on every controller
     step, place_p, place_t = make_lm_train_step(mesh, params=params, lr=0.1)
     params = place_p(params)
