@@ -46,6 +46,28 @@ def main():
           flush=True)
     assert losses[-1] < losses[0]
 
+    # checkpoint leg: a COORDINATED orbax save of the sharded train state
+    # across both controllers, restored back onto the global mesh shardings
+    import tempfile
+
+    from parsec_tpu.utils.model_ckpt import (restore_train_state,
+                                             save_train_state)
+    from parsec_tpu.parallel.multihost import ENV_COORD
+    job = os.environ.get(ENV_COORD, "solo").replace(":", "_").replace(".", "-")
+    ckdir = os.path.join(tempfile.gettempdir(), f"mh_ckpt_{job}")
+    save_train_state(ckdir, params, None, step=3)
+    like = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        params)
+    p2, _, got_step = restore_train_state(ckdir, like=(like, None))
+    assert got_step == 3
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(p2)):
+        sa = np.asarray(a.addressable_shards[0].data)
+        sb = np.asarray(b.addressable_shards[0].data)
+        assert sa.shape == sb.shape and np.allclose(sa, sb)
+    print(f"MHCKPT pid={pid} step={got_step} ok=1", flush=True)
+
     # long-context leg: causal ring attention with the SEQUENCE axis
     # sharded across both controllers — the K/V ppermute ring crosses the
     # process boundary every hop

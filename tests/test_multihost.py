@@ -40,6 +40,8 @@ def test_two_controller_global_mesh_lm_train_step():
     spans = sorted(re.search(r"MHRING pid=\d+ err=[\d.e-]+ span=(\d+):(\d+)",
                              o).groups() for o in outs)
     assert spans == [("0", "32"), ("32", "64")], spans
+    # both controllers completed the coordinated sharded orbax save/restore
+    assert all(re.search(r"MHCKPT pid=\d+ step=3 ok=1", o) for o in outs)
 
     # and the global 2-process run computes the SAME numbers as one
     # process with the same 8-device mesh: the mesh is the program, the
