@@ -68,6 +68,26 @@ def main():
         assert sa.shape == sb.shape and np.allclose(sa, sb)
     print(f"MHCKPT pid={pid} step={got_step} ok=1", flush=True)
 
+    # expert-parallel leg: top-2 MoE with the EXPERTS split across the
+    # controllers — the dispatch/combine all_to_all crosses the boundary
+    from jax.sharding import Mesh
+    from parsec_tpu.parallel.moe import (dense_reference, init_moe_params,
+                                         moe_forward)
+    emesh = Mesh(np.array(jax.devices()), ("ep",))
+    eE, eD, eT = len(jax.devices()), 16, 4 * len(jax.devices())
+    mo_params = init_moe_params(0, eE, eD, 32)
+    mx = np.random.default_rng(7).standard_normal((eT, eD)).astype(np.float32)
+    mout, maux = moe_forward(mo_params, mx, mesh=emesh, k=2, return_aux=True)
+    mref = np.asarray(dense_reference(mo_params, mx, k=2))
+    mo_shards = sorted(mout.addressable_shards,
+                       key=lambda s: s.index[0].start or 0)
+    mo_lo = mo_shards[0].index[0].start or 0
+    mo_hi = mo_shards[-1].index[0].stop
+    mo_got = np.concatenate([np.asarray(s.data) for s in mo_shards], axis=0)
+    mo_err = float(np.abs(mo_got - mref[mo_lo:mo_hi]).max())
+    print(f"MHMOE pid={pid} err={mo_err:.2e}", flush=True)
+    assert mo_err < 1e-4
+
     # long-context leg: causal ring attention with the SEQUENCE axis
     # sharded across both controllers — the K/V ppermute ring crosses the
     # process boundary every hop

@@ -42,6 +42,8 @@ def test_two_controller_global_mesh_lm_train_step():
     assert spans == [("0", "32"), ("32", "64")], spans
     # both controllers completed the coordinated sharded orbax save/restore
     assert all(re.search(r"MHCKPT pid=\d+ step=3 ok=1", o) for o in outs)
+    # the MoE dispatch/combine all_to_all crossed the boundary too
+    assert all(re.search(r"MHMOE pid=\d+ err=", o) for o in outs)
 
     # and the global 2-process run computes the SAME numbers as one
     # process with the same 8-device mesh: the mesh is the program, the
