@@ -101,6 +101,89 @@ def lm_apply(params: dict, tokens, causal: bool = True, attention=None,
                       preferred_element_type=jnp.float32)
 
 
+# ----------------------------------------------------------- MoE-LM family
+
+def init_lm_moe_params(seed: int, cfg: ModelConfig, n_experts: int) -> dict:
+    """Switch/Mixtral-class variant: every block's position-wise MLP is
+    replaced by a router + ``n_experts`` expert MLPs (hidden ``cfg.d_ff``).
+    Attention/embedding/LN params are identical to :func:`init_lm_params`."""
+    from .moe import init_moe_params
+    p = init_lm_params(seed, cfg)
+    for i, bp in enumerate(p["blocks"]):
+        for k in ("w1", "b1", "w2", "b2"):
+            bp.pop(k)
+        bp["moe"] = init_moe_params(seed + 101 + i, n_experts,
+                                    cfg.d_model, cfg.d_ff)
+    return p
+
+
+def lm_moe_apply(params: dict, tokens, causal: bool = True, k: int = 2,
+                 mesh=None, capacity_factor: Optional[float] = None,
+                 return_aux: bool = False):
+    """MoE-LM forward: logits (B, S, V), with each block's FFN routed
+    through its top-``k`` experts.
+
+    ``mesh=None`` computes the routed FFN densely (every token through its
+    selected experts, no parallelism — the truth). With an ``ep`` mesh the
+    experts are SHARDED over it and dispatch/combine ride ``all_to_all``
+    (:func:`parsec_tpu.parallel.moe.moe_forward`); with no-drop capacity
+    (the default) both paths agree, and the whole forward jits and
+    differentiates (moe_forward skips host placement under a trace).
+    ``return_aux=True`` adds ``{"aux_loss", "dropped"}`` — the mean Switch
+    load-balancing loss over blocks (add ``lambda*aux`` to the training
+    objective) and the total overflow drops (always 0 on the dense
+    path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .moe import _topk_gates, dense_reference, moe_forward
+
+    tokens = jnp.asarray(tokens)
+    B, S = tokens.shape
+    if S > params["pos"].shape[0]:
+        raise ValueError(f"sequence length {S} exceeds the model's "
+                         f"max_seq {params['pos'].shape[0]}")
+    x = params["embed"][tokens] + params["pos"][:S][None]
+    aux_acc, drop_acc = [], []
+    for bp in params["blocks"]:
+        mp = bp["moe"]
+
+        def ffn(h, mp=mp):
+            h2 = h.reshape(B * S, -1)
+            if mesh is None:
+                if return_aux:
+                    # Switch aux loss from the EXACT routed activation
+                    # (the mesh path reuses moe_forward's own computation)
+                    E = mp["w1"].shape[0]
+                    probs = jax.nn.softmax(h2 @ mp["router"], axis=-1)
+                    _, eid = _topk_gates(probs, k)
+                    f = jnp.mean(jax.nn.one_hot(eid[:, 0], E,
+                                                dtype=jnp.float32), axis=0)
+                    aux_acc.append(E * jnp.sum(
+                        f * probs.astype(jnp.float32).mean(0)))
+                    drop_acc.append(jnp.float32(0.0))   # no-drop by def
+                out = dense_reference(mp, h2, k=k)
+            elif return_aux:
+                out, a = moe_forward(mp, h2, mesh=mesh, k=k,
+                                     capacity_factor=capacity_factor,
+                                     return_aux=True)
+                aux_acc.append(a["aux_loss"])
+                drop_acc.append(a["dropped"])
+            else:
+                out = moe_forward(mp, h2, mesh=mesh, k=k,
+                                  capacity_factor=capacity_factor)
+            return jnp.asarray(out).reshape(B, S, -1)
+
+        x = block_apply(bp, x, causal=causal, ffn=ffn)
+    h = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits = jnp.einsum("bsd,vd->bsv", h, params["embed"],
+                        preferred_element_type=jnp.float32)
+    if return_aux:
+        return logits, {"aux_loss": sum(aux_acc) / len(aux_acc),
+                        "dropped": sum(drop_acc)}
+    return logits
+
+
 def lm_loss(params: dict, tokens, targets, causal: bool = True,
             attention=None, remat: bool = False, compute_dtype=None):
     """Mean next-token cross-entropy; ``targets`` (B, S) int32."""

@@ -184,12 +184,21 @@ def moe_forward(params, x, mesh=None, capacity: Optional[int] = None,
     else:
         cap = t_loc
     fn = _moe_call(mesh, cap, E // nP, k)
-    ns = lambda spec: NamedSharding(mesh, spec)
-    rd = jax.device_put(params["router"], ns(P()))
-    w1 = jax.device_put(params["w1"], ns(P(axis, None, None)))
-    w2 = jax.device_put(params["w2"], ns(P(axis, None, None)))
-    xd = jax.device_put(np.asarray(x), ns(P(axis, None)))
-    y, aux, dropped = fn(rd, w1, w2, xd)
+    import jax.core
+    leaves = [params["router"], params["w1"], params["w2"], x]
+    if any(isinstance(v, jax.core.Tracer) for v in leaves):
+        # under an outer jit/grad trace: no host-side placement — the
+        # shard_map in_specs become sharding constraints and gradients
+        # flow through dispatch/combine (the MoE-LM training path)
+        y, aux, dropped = fn(params["router"], params["w1"],
+                             params["w2"], x)
+    else:
+        ns = lambda spec: NamedSharding(mesh, spec)
+        rd = jax.device_put(params["router"], ns(P()))
+        w1 = jax.device_put(params["w1"], ns(P(axis, None, None)))
+        w2 = jax.device_put(params["w2"], ns(P(axis, None, None)))
+        xd = jax.device_put(np.asarray(x), ns(P(axis, None)))
+        y, aux, dropped = fn(rd, w1, w2, xd)
     if return_aux:
         return y, {"aux_loss": aux, "dropped": dropped}
     return y

@@ -77,14 +77,16 @@ def flash_attention_core(q, k, v, causal: bool, scale: float):
 
 
 def block_apply(params, x, causal: bool = True, attention=None,
-                return_kv: bool = False):
+                return_kv: bool = False, ffn=None):
     """One pre-LN transformer block: x -> x + MHA(LN(x)) -> + MLP(LN(.)).
 
     ``x``: (batch, seq, d_model). Pure jax math — the sharding story is
     entirely in the jit annotations of :func:`make_train_step`.
     ``attention(q, k, v, causal, scale)`` swaps the attention core (the
-    sequence-parallel variant passes the ring). ``return_kv=True``
-    additionally returns this block's (k, v) — the KV-cache prefill seed
+    sequence-parallel variant passes the ring). ``ffn(h) -> h`` swaps the
+    position-wise MLP (the MoE-LM routes it through experts) — the
+    residual add stays here. ``return_kv=True`` additionally returns this
+    block's (k, v) — the KV-cache prefill seed
     (:func:`parsec_tpu.parallel.model.lm_generate`) — so generation shares
     THIS function's math rather than re-implementing it."""
     import jax
@@ -98,8 +100,11 @@ def block_apply(params, x, causal: bool = True, attention=None,
     x = x + jnp.einsum("bhsd,hdo->bso", ctx, params["wo"])
 
     h = _ln(x, params["ln2_g"], params["ln2_b"])
-    h = jax.nn.gelu(h @ params["w1"] + params["b1"])
-    out = x + h @ params["w2"] + params["b2"]
+    if ffn is not None:
+        out = x + ffn(h)
+    else:
+        h = jax.nn.gelu(h @ params["w1"] + params["b1"])
+        out = x + h @ params["w2"] + params["b2"]
     if return_kv:
         return out, qkv[1], qkv[2]
     return out

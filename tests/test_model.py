@@ -343,3 +343,113 @@ def test_lm_pipeline_parallel_forward_matches_dense():
                                    err_msg=f"pp={nP} micro={m}")
     with pytest.raises(ValueError, match="stages"):
         lm_pp_forward(params, toks, mesh=make_pp_mesh(8))
+
+
+# ----------------------------------------------------------- MoE-LM family
+
+def test_lm_moe_expert_parallel_matches_dense():
+    """The Switch-class LM: every block's FFN routed through top-2 of 8
+    experts. Expert-parallel over the ep mesh (all_to_all dispatch) equals
+    the dense routed forward under no-drop capacity; aux loss is sane."""
+    import jax
+    from parsec_tpu.parallel.model import (ModelConfig, init_lm_moe_params,
+                                           lm_moe_apply)
+    from parsec_tpu.parallel.moe import make_ep_mesh
+
+    mesh = make_ep_mesh()
+    nP = mesh.devices.size
+    cfg = ModelConfig(vocab_size=64, d_model=32, d_ff=64, n_heads=4,
+                      n_layers=2, max_seq=16)
+    params = init_lm_moe_params(0, cfg, n_experts=nP)
+    toks = (np.arange(64, dtype=np.int32).reshape(8, 8) * 7) % 64
+
+    dense, aux_d = lm_moe_apply(params, toks, k=2, return_aux=True)
+    ep, aux_e = lm_moe_apply(params, toks, k=2, mesh=mesh, return_aux=True)
+    np.testing.assert_allclose(np.asarray(ep), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+    assert float(aux_d["aux_loss"]) >= 1.0 - 1e-4
+    np.testing.assert_allclose(float(aux_e["aux_loss"]),
+                               float(aux_d["aux_loss"]), rtol=1e-4)
+    # the router actually spreads tokens: logits differ from a k=1 routing
+    top1 = lm_moe_apply(params, toks, k=1)
+    assert np.abs(np.asarray(top1) - np.asarray(dense)).max() > 1e-6
+
+
+def test_lm_moe_trains():
+    """Gradients flow through routing gates and experts: a few SGD steps
+    on the dense routed path reduce the LM loss."""
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu.parallel.model import (ModelConfig, init_lm_moe_params,
+                                           lm_moe_apply)
+
+    cfg = ModelConfig(vocab_size=32, d_model=16, d_ff=32, n_heads=2,
+                      n_layers=1, max_seq=8)
+    params = init_lm_moe_params(1, cfg, n_experts=4)
+    rng = np.random.default_rng(2)
+    toks = rng.integers(0, 32, size=(4, 8)).astype(np.int32)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+
+    def loss_fn(p):
+        logits, aux = lm_moe_apply(p, tokens, k=2, return_aux=True)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, jnp.asarray(targets)[..., None],
+                                   axis=-1).squeeze(-1)
+        return jnp.mean(logz - gold) + 0.01 * aux["aux_loss"]
+
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    losses = []
+    for _ in range(5):
+        l, g = vg(params)
+        losses.append(float(l))
+        params = jax.tree_util.tree_map(lambda p, gr: p - 0.1 * gr,
+                                        params, g)
+    assert losses[-1] < losses[0], losses
+
+
+def test_lm_moe_ep_path_jits_and_differentiates():
+    """The expert-parallel forward composes under jit AND grad: gradients
+    flow through the all_to_all dispatch/combine (moe_forward skips host
+    placement when traced)."""
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu.parallel.model import (ModelConfig, init_lm_moe_params,
+                                           lm_moe_apply)
+    from parsec_tpu.parallel.moe import make_ep_mesh
+
+    mesh = make_ep_mesh()
+    cfg = ModelConfig(vocab_size=32, d_model=16, d_ff=32, n_heads=2,
+                      n_layers=1, max_seq=8)
+    params = init_lm_moe_params(3, cfg, n_experts=mesh.devices.size)
+    rng = np.random.default_rng(4)
+    toks = rng.integers(0, 32, size=(mesh.devices.size, 8)).astype(np.int32)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+
+    def loss_fn(p):
+        logits = lm_moe_apply(p, tokens, k=2, mesh=mesh)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, jnp.asarray(targets)[..., None],
+                                   axis=-1).squeeze(-1)
+        return jnp.mean(logz - gold)
+
+    l0, g = jax.jit(jax.value_and_grad(loss_fn))(params)
+    gnorm = sum(float(jnp.abs(x).sum()) for x in jax.tree_util.tree_leaves(g))
+    assert np.isfinite(float(l0)) and gnorm > 0
+    # expert weights got gradients (routing reached them through a2a)
+    ge = g["blocks"][0]["moe"]["w1"]
+    assert float(jnp.abs(ge).max()) > 0
+
+    # one step reduces the loss on the same path
+    p2 = jax.tree_util.tree_map(lambda p, gr: p - 0.2 * gr, params, g)
+    l1 = jax.jit(loss_fn)(p2)
+    assert float(l1) < float(l0)
+
+
+def test_lm_moe_seq_length_guard():
+    from parsec_tpu.parallel.model import (ModelConfig, init_lm_moe_params,
+                                           lm_moe_apply)
+    cfg = ModelConfig(vocab_size=32, d_model=16, d_ff=32, n_heads=2,
+                      n_layers=1, max_seq=8)
+    params = init_lm_moe_params(0, cfg, n_experts=4)
+    with pytest.raises(ValueError, match="max_seq"):
+        lm_moe_apply(params, np.zeros((2, 16), np.int32))
