@@ -199,10 +199,12 @@ def lm_loss(params: dict, tokens, targets, causal: bool = True,
 
 
 
-def _decode_block(bp, x, ck, cv, pos, scale):
+def _decode_block(bp, x, ck, cv, pos, scale, ffn=None):
     """One transformer block for ONE new token at position ``pos`` against
     KV caches (B, H, S, dh): the TPU-idiomatic incremental step — static
-    shapes, `dynamic_update_slice` cache writes, position-masked scores."""
+    shapes, `dynamic_update_slice` cache writes, position-masked scores.
+    ``ffn`` swaps the position-wise MLP exactly like ``block_apply``'s
+    hook (the MoE-LM passes its routed closure to BOTH)."""
     import jax
     import jax.numpy as jnp
     h = _ln(x, bp["ln1_g"], bp["ln1_b"])                     # (B, 1, D)
@@ -217,6 +219,8 @@ def _decode_block(bp, x, ck, cv, pos, scale):
     o = jnp.einsum("bhqk,bhkd->bhqd", a, cv)
     x = x + jnp.einsum("bhsd,hdo->bso", o, bp["wo"])
     h = _ln(x, bp["ln2_g"], bp["ln2_b"])
+    if ffn is not None:
+        return x + ffn(h), ck, cv
     h = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
     return x + h @ bp["w2"] + bp["b2"], ck, cv
 
@@ -226,9 +230,21 @@ def _decode_block(bp, x, ck, cv, pos, scale):
 # recompile past the bound instead of leaking executables without limit
 @functools.lru_cache(maxsize=16)
 def _compiled_generate(n_layers: int, prompt_len: int, n_tokens: int,
-                       greedy: bool, temperature: float):
+                       greedy: bool, temperature: float,
+                       moe_k: Optional[int] = None):
     import jax
     import jax.numpy as jnp
+
+    def _ffn_of(bp):
+        if moe_k is None:
+            return None
+        from .moe import dense_reference
+
+        def ffn(h, bp=bp):
+            flat = dense_reference(bp["moe"], h.reshape(-1, h.shape[-1]),
+                                   k=moe_k)
+            return flat.reshape(h.shape)
+        return ffn
 
     def generate(params, prompt, key):
         B = prompt.shape[0]
@@ -241,7 +257,8 @@ def _compiled_generate(n_layers: int, prompt_len: int, n_tokens: int,
         x = params["embed"][prompt] + params["pos"][:prompt_len][None]
         cks, cvs = [], []
         for bp in params["blocks"]:
-            x, k, v = block_apply(bp, x, causal=True, return_kv=True)
+            x, k, v = block_apply(bp, x, causal=True, return_kv=True,
+                                  ffn=_ffn_of(bp))
             pad = [(0, 0), (0, 0), (0, S - prompt_len), (0, 0)]
             cks.append(jnp.pad(k, pad))
             cvs.append(jnp.pad(v, pad))
@@ -266,7 +283,7 @@ def _compiled_generate(n_layers: int, prompt_len: int, n_tokens: int,
             new_k, new_v = [], []
             for li, bp in enumerate(params["blocks"]):
                 x, ck, cv = _decode_block(bp, x, cks[li], cvs[li], pos,
-                                          scale)
+                                          scale, ffn=_ffn_of(bp))
                 new_k.append(ck)
                 new_v.append(cv)
             h = _ln(x, params["lnf_g"], params["lnf_b"])
@@ -284,13 +301,16 @@ def _compiled_generate(n_layers: int, prompt_len: int, n_tokens: int,
 
 
 def lm_generate(params: dict, prompt, n_tokens: int, greedy: bool = True,
-                temperature: float = 1.0, key=None):
+                temperature: float = 1.0, key=None,
+                moe_k: Optional[int] = None):
     """Autoregressive generation with per-layer KV caches: ONE compiled
     program — full-prompt prefill seeds the caches, then a ``lax.scan``
     decode loop (static shapes, `dynamic_update_slice` cache writes).
     ``prompt`` (B, P) int32; returns (B, P + n_tokens). Greedy by default;
     ``greedy=False`` samples at ``temperature`` using ``key``
-    (``temperature <= 0`` means greedy)."""
+    (``temperature <= 0`` means greedy). MoE-LM params (blocks carrying a
+    ``moe`` sub-dict) decode with their FFNs routed top-``moe_k``
+    (defaults to 2 when detected)."""
     import jax
     prompt = np.asarray(prompt) if not hasattr(prompt, "dtype") else prompt
     P = prompt.shape[1]
@@ -304,9 +324,12 @@ def lm_generate(params: dict, prompt, n_tokens: int, greedy: bool = True,
             f"{params['pos'].shape[0]}")
     if key is None:
         key = jax.random.PRNGKey(0)
+    if moe_k is None and "moe" in params["blocks"][0]:
+        moe_k = 2
     fn = _compiled_generate(len(params["blocks"]), int(P), int(n_tokens),
                             bool(greedy),
-                            1.0 if greedy else float(temperature))
+                            1.0 if greedy else float(temperature),
+                            None if moe_k is None else int(moe_k))
     return fn(params, prompt, key)
 
 
