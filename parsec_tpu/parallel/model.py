@@ -184,6 +184,35 @@ def lm_moe_apply(params: dict, tokens, causal: bool = True, k: int = 2,
     return logits
 
 
+def make_lm_moe_train_step(mesh=None, k: int = 2, lr: float = 1e-2,
+                           aux_weight: float = 0.01, causal: bool = True):
+    """A jitted SGD step for the MoE-LM: token cross-entropy plus
+    ``aux_weight`` x the Switch load-balancing loss, gradients through the
+    expert dispatch (the ``ep`` mesh's all_to_all when ``mesh`` is given,
+    the dense routed truth otherwise). Returns
+    ``step(params, tokens, targets) -> (params, loss)``; losses from both
+    paths agree under no-drop capacity."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(p, tokens, targets):
+        logits, aux = lm_moe_apply(p, tokens, causal=causal, k=k,
+                                   mesh=mesh, return_aux=True)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None],
+                                   axis=-1).squeeze(-1)
+        return jnp.mean(logz - gold) + aux_weight * aux["aux_loss"]
+
+    @jax.jit
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                        params, grads)
+        return params, loss
+
+    return step
+
+
 def lm_loss(params: dict, tokens, targets, causal: bool = True,
             attention=None, remat: bool = False, compute_dtype=None):
     """Mean next-token cross-entropy; ``targets`` (B, S) int32."""

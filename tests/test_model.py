@@ -476,3 +476,33 @@ def test_lm_moe_generate_matches_full_recompute():
         nxt = logits[:, -1].argmax(-1).astype(np.int32)
         naive = np.concatenate([naive, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(out, naive)
+
+
+def test_make_lm_moe_train_step_ep_matches_dense():
+    """The packaged MoE-LM train step: losses on the expert-parallel path
+    track the dense-routed path step for step (no-drop capacity), and
+    both train."""
+    from parsec_tpu.parallel.model import (ModelConfig, init_lm_moe_params,
+                                           make_lm_moe_train_step)
+    from parsec_tpu.parallel.moe import make_ep_mesh
+
+    mesh = make_ep_mesh()
+    cfg = ModelConfig(vocab_size=32, d_model=16, d_ff=32, n_heads=2,
+                      n_layers=1, max_seq=8)
+    rng = np.random.default_rng(6)
+    toks = rng.integers(0, 32, size=(mesh.devices.size, 8)).astype(np.int32)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+
+    def run(m):
+        params = init_lm_moe_params(5, cfg, n_experts=mesh.devices.size)
+        step = make_lm_moe_train_step(mesh=m, k=2, lr=0.1)
+        out = []
+        for _ in range(3):
+            params, loss = step(params, tokens, targets)
+            out.append(float(loss))
+        return out
+
+    dense_losses = run(None)
+    ep_losses = run(mesh)
+    np.testing.assert_allclose(ep_losses, dense_losses, rtol=2e-4, atol=2e-4)
+    assert dense_losses[-1] < dense_losses[0]
