@@ -119,7 +119,7 @@ def init_lm_moe_params(seed: int, cfg: ModelConfig, n_experts: int) -> dict:
 
 def lm_moe_apply(params: dict, tokens, causal: bool = True, k: int = 2,
                  mesh=None, capacity_factor: Optional[float] = None,
-                 return_aux: bool = False):
+                 return_aux: bool = False, remat: bool = False):
     """MoE-LM forward: logits (B, S, V), with each block's FFN routed
     through its top-``k`` experts.
 
@@ -143,6 +143,12 @@ def lm_moe_apply(params: dict, tokens, causal: bool = True, k: int = 2,
     if S > params["pos"].shape[0]:
         raise ValueError(f"sequence length {S} exceeds the model's "
                          f"max_seq {params['pos'].shape[0]}")
+    if remat and return_aux:
+        # the aux accumulator is a host-side closure; a rematerialized
+        # backward would replay the appends and double-count it
+        raise ValueError("remat=True is incompatible with return_aux=True "
+                         "(compute the aux loss in a separate un-rematted "
+                         "forward)")
     x = params["embed"][tokens] + params["pos"][:S][None]
     aux_acc, drop_acc = [], []
     for bp in params["blocks"]:
@@ -174,7 +180,11 @@ def lm_moe_apply(params: dict, tokens, causal: bool = True, k: int = 2,
                                   capacity_factor=capacity_factor)
             return jnp.asarray(out).reshape(B, S, -1)
 
-        x = block_apply(bp, x, causal=causal, ffn=ffn)
+        blk = (jax.checkpoint(functools.partial(
+                   block_apply, causal=causal, ffn=ffn))
+               if remat else
+               functools.partial(block_apply, causal=causal, ffn=ffn))
+        x = blk(bp, x)
     h = _ln(x, params["lnf_g"], params["lnf_b"])
     logits = jnp.einsum("bsd,vd->bsv", h, params["embed"],
                         preferred_element_type=jnp.float32)
