@@ -489,3 +489,74 @@ def test_tcp_xhost_disabled_bounces_and_counts():
         assert s["d2d"] == 0, s
         assert s["bounced"] == 1, s       # counted fallback
         assert s["pins"] == -1, s         # no xhost plane was built
+
+
+def _potrf_device_xhost_program(rank, ce):
+    """The full stack with the device-native cross-rank plane ON: DTD
+    POTRF over the TCP mesh with comm_device_mem=1. POTRF's panels are
+    PRODUCED by tasks (jit outputs = device-resident arrays) and consumed
+    remotely, so the protocol's sends carry device payloads — which must
+    ride PJRT transfer-server pulls (rendezvous descriptors in the AM
+    frames), not wire bytes. (A plain GEMM only ships host-FILLED input
+    tiles — legitimately host content — so it never exercises this.)"""
+    import os
+    os.environ["PARSEC_TPU_LOCAL_DEVICE"] = "0"
+    _force_cpu()
+    from parsec_tpu.comm.engine import CAP_ACCELERATOR_MEM
+    from parsec_tpu.comm.xhost import XHostTransfer
+    from parsec_tpu.utils import mca
+    from parsec_tpu.utils.counters import counters
+    mca.set("comm_device_mem", True)
+    # the CE predates the flag in this harness; wire the plane as
+    # __init__ would
+    ce._xhost = ce._xpull = XHostTransfer()
+    ce.capabilities |= CAP_ACCELERATOR_MEM
+
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    from parsec_tpu.ops.potrf import insert_potrf_tasks, make_spd
+
+    spd = make_spd(N, seed=_SEED)
+    ctx = _mkctx(rank, ce)
+    A = TwoDimBlockCyclic("A", N, N, TS, TS, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    A.fill(lambda m, n: spd[m*TS:(m+1)*TS, n*TS:(n+1)*TS])
+    tp = DTDTaskpool(ctx, "xhostpotrf")
+    insert_potrf_tasks(tp, A)
+    tp.wait(timeout=90)
+    tp.close()
+    ctx.wait(timeout=60)
+    ctx.fini()
+    stats = {
+        "offered": int(counters.read("comm.xhost_offered_msgs")),
+        "pulled": int(counters.read("comm.xhost_d2d_msgs")),
+        "bounced": int(counters.read("comm.host_materialized_msgs")),
+        "pins": ce._xhost.pending(),
+    }
+    ce.sync()
+    ce.fini()
+    L = np.linalg.cholesky(spd.astype(np.float64))
+    err = 0.0
+    for m in range(A.mt):
+        for n in range(A.nt):
+            if A.rank_of(m, n) == rank and m >= n:
+                got = np.asarray(A.data_of(m, n).newest_copy().payload,
+                                 np.float64)
+                err = max(err, float(np.abs(
+                    got - L[m*TS:(m+1)*TS, n*TS:(n+1)*TS]).max()))
+    return dict(stats, err=err)
+
+
+def test_tcp_distributed_potrf_device_payloads_via_xhost():
+    """End-to-end: the remote-dep protocol's PRODUCED tile payloads
+    (device-resident jit outputs) cross OS ranks via PJRT pulls; results
+    correct, zero host materializations, all pins retired."""
+    results = run_distributed_procs(2, _potrf_device_xhost_program,
+                                    timeout=240)
+    for s in results:
+        assert s["err"] < 1e-2, s
+        assert s["bounced"] == 0, s        # nothing host-materialized
+        assert s["pins"] == 0, s           # every ACK arrived
+    total_offered = sum(s["offered"] for s in results)
+    total_pulled = sum(s["pulled"] for s in results)
+    assert total_offered == total_pulled > 0, results
