@@ -119,8 +119,14 @@ def run_multicontroller(nprocs: int, script: str,
         env[ENV_PROC] = str(pid)
         env[ENV_NPROC] = str(nprocs)
         env["PARSEC_TPU_FORCE_CPU"] = "1"
-        flag = f"--xla_force_host_platform_device_count={devices_per_proc}"
-        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+        # replace (not append after) any inherited device-count flag: the
+        # caller may itself run under a virtual-device env, and relying on
+        # last-flag-wins is fragile
+        kept = [f for f in env.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform_device_count")]
+        kept.append(f"--xla_force_host_platform_device_count="
+                    f"{devices_per_proc}")
+        env["XLA_FLAGS"] = " ".join(kept)
         if extra_env:
             env.update(extra_env)
         procs.append(subprocess.Popen(
