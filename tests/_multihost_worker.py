@@ -46,6 +46,25 @@ def main():
           flush=True)
     assert losses[-1] < losses[0]
 
+    # input-feeding leg: the REAL multi-host idiom — each controller
+    # contributes only ITS dp shard of the global batch
+    # (host_local_to_global = make_array_from_process_local_data); the
+    # assembled batch must reproduce the place_t loss exactly
+    from jax.sharding import PartitionSpec as P
+    from parsec_tpu.parallel.multihost import host_local_to_global
+    nproc = int(os.environ.get("PARSEC_TPU_NUM_PROCESSES", "1"))
+    rows = toks.shape[0] // nproc
+    mine = toks[pid * rows:(pid + 1) * rows]
+    g_tok = host_local_to_global(mesh, P("dp", None), mine[:, :-1])
+    g_tgt = host_local_to_global(mesh, P("dp", None), mine[:, 1:])
+    p0 = place_p(init_lm_params(0, cfg))
+    _, loss_fed = step(p0, g_tok, g_tgt)
+    _, loss_ref = step(place_p(init_lm_params(0, cfg)), tokens, targets)
+    df = abs(float(fetch_replicated(loss_fed)) -
+             float(fetch_replicated(loss_ref)))
+    print(f"MHFEED pid={pid} diff={df:.2e}", flush=True)
+    assert df < 1e-6
+
     # checkpoint leg: a COORDINATED orbax save of the sharded train state
     # across both controllers, restored back onto the global mesh shardings
     import tempfile

@@ -44,6 +44,9 @@ def test_two_controller_global_mesh_lm_train_step():
     assert all(re.search(r"MHCKPT pid=\d+ step=3 ok=1", o) for o in outs)
     # the MoE dispatch/combine all_to_all crossed the boundary too
     assert all(re.search(r"MHMOE pid=\d+ err=", o) for o in outs)
+    # per-host input shards assembled into the global batch reproduce the
+    # replicated-feed loss exactly
+    assert all(re.search(r"MHFEED pid=\d+ diff=", o) for o in outs)
 
     # and the global 2-process run computes the SAME numbers as one
     # process with the same 8-device mesh: the mesh is the program, the
