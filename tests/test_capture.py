@@ -657,3 +657,25 @@ def test_scan_capture_scales_to_hundreds_of_tasks(ctx):
     L = np.tril(np.asarray(P.to_dense(), np.float64))
     np.testing.assert_allclose(
         L, np.linalg.cholesky(spd.astype(np.float64)), rtol=0, atol=1e-4)
+
+
+def test_scan_capture_multi_write_flows(ctx):
+    """A body with TWO write flows under the scan interpreter: both
+    outputs land in their stores in argument order (the inline path's
+    semantics)."""
+    def swapscale(a, b):
+        return b * 2.0, a * 3.0             # writes (a_new, b_new)
+
+    cap = DTDTaskpool(ctx, "zmw", capture="scan")
+    ta = cap.tile_new((4, 4), np.float32)
+    tb = cap.tile_new((4, 4), np.float32)
+    ta.data.create_copy(0, np.full((4, 4), 1.0, np.float32))
+    tb.data.create_copy(0, np.full((4, 4), 10.0, np.float32))
+    cap.insert_task(swapscale, (ta, RW), (tb, RW))
+    cap.insert_task(swapscale, (ta, RW), (tb, RW))
+    cap.wait()
+    cap.close()
+    ctx.wait(timeout=30)
+    # step1: a=20, b=3; step2: a=6, b=60
+    np.testing.assert_allclose(np.asarray(ta.data.newest_copy().payload), 6.0)
+    np.testing.assert_allclose(np.asarray(tb.data.newest_copy().payload), 60.0)

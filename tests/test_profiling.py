@@ -440,3 +440,32 @@ def test_trace_perf_bench_runs():
     assert got["value"] > 10_000                      # trivially exceeded
     assert got["n_events"] == 2000 + 2 * (2000 // 2) + 2000 + 2000 // 10
     assert got["dump_events_per_sec"] > 0 and got["read_events_per_sec"] > 0
+
+
+def test_mem_view_reads_ptf2_archive(ctx, tmp_path):
+    """mem_view consumes the OTF2-class backend identically to PBP."""
+    from parsec_tpu.device.tpu import TPUDevice
+    from parsec_tpu.tools import mem_view
+    from parsec_tpu.tools.trace_reader import read_trace
+    from parsec_tpu.utils import mca
+
+    # reuse the tracer state by emitting synthetic ::mem events
+    prof = Profiling()
+    key, _ = prof.add_dictionary_keyword("dev0::mem",
+                                         info_desc="resident{q};delta{q}")
+    s = prof.stream("dev0")
+    from parsec_tpu.utils.trace import EVENT_FLAG_POINT
+    run = 0
+    for i, d in enumerate([1024, 2048, -1024, 512]):
+        run += d
+        s.trace(key, i, 0, EVENT_FLAG_POINT,
+                prof.pack_info("dev0::mem", resident=run, delta=d))
+
+    pbp = prof.dump(str(tmp_path / "m.pbp"))
+    arch = prof.dump(str(tmp_path / "m"), backend="otf2")
+    rows_pbp = mem_view.memory_timeline(read_trace(pbp))
+    rows_otf = mem_view.memory_timeline(read_trace(arch))
+    assert [(r["resident"], r["delta"]) for r in rows_pbp] == \
+        [(r["resident"], r["delta"]) for r in rows_otf] == \
+        [(1024, 1024), (3072, 2048), (2048, -1024), (2560, 512)]
+    assert mem_view.summarize(read_trace(arch))["dev0"]["peak"] == 3072
