@@ -1,0 +1,81 @@
+"""Unit tests for the cross-host device-payload plane (comm/xhost.py):
+the PJRT transfer server loopback, pin lifecycle, and the concurrent
+first-offer race (both threads must observe ONE server)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.xhost import XHostRef, XHostTransfer
+
+
+@pytest.fixture(scope="module")
+def xh():
+    if not XHostTransfer.available():
+        pytest.skip("jax.experimental.transfer unavailable")
+    return XHostTransfer()
+
+
+def test_offer_pull_loopback_and_pin_lifecycle(xh):
+    import jax.numpy as jnp
+    x = jnp.arange(64.0).reshape(8, 8)
+    ref = xh.offer(x, dst=3)
+    assert isinstance(ref, XHostRef)
+    assert ref.shape == (8, 8) and ref.dtype == "float32"
+    assert xh.pending() == 1                  # pinned until ACK
+    got = xh.pull(ref)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x))
+    xh.retire(ref.uuid)
+    assert xh.pending() == 0
+
+
+def test_bfloat16_round_trip(xh):
+    import jax.numpy as jnp
+    x = jnp.full((4, 4), 2.5, jnp.bfloat16)
+    ref = xh.offer(x)
+    assert ref.dtype == "bfloat16"            # NAME, not raw-void '<V2'
+    got = xh.pull(ref)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got.astype(jnp.float32)), 2.5)
+    xh.retire(ref.uuid)
+
+
+def test_retire_peer_and_clear(xh):
+    import jax.numpy as jnp
+    for dst in (1, 1, 2):
+        xh.offer(jnp.zeros((2, 2)), dst=dst)
+    assert xh.pending() == 3
+    xh.retire_peer(1)                         # dead peer: its pulls never come
+    assert xh.pending() == 1
+    xh.clear()
+    assert xh.pending() == 0
+
+
+def test_concurrent_first_offers_share_one_server():
+    """Two threads race the lazy server init: both refs must carry the
+    SAME server address (the loser of an unlocked race would stamp a
+    garbage-collected server into its ref) and both must be pullable."""
+    if not XHostTransfer.available():
+        pytest.skip("jax.experimental.transfer unavailable")
+    import jax.numpy as jnp
+    fresh = XHostTransfer()
+    refs = [None, None]
+    barrier = threading.Barrier(2)
+
+    def offerer(i):
+        barrier.wait()
+        refs[i] = fresh.offer(jnp.full((4,), float(i + 1)))
+
+    ts = [threading.Thread(target=offerer, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert refs[0] is not None and refs[1] is not None
+    assert refs[0].address == refs[1].address == fresh.address
+    for i, ref in enumerate(refs):
+        got = fresh.pull(ref)
+        np.testing.assert_allclose(np.asarray(got), float(i + 1))
+        fresh.retire(ref.uuid)
+    assert fresh.pending() == 0
