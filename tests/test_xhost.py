@@ -79,3 +79,22 @@ def test_concurrent_first_offers_share_one_server():
         np.testing.assert_allclose(np.asarray(got), float(i + 1))
         fresh.retire(ref.uuid)
     assert fresh.pending() == 0
+
+
+def test_tcpce_flag_on_but_transfer_unavailable_warns_and_bounces(monkeypatch):
+    """comm_device_mem=1 on a jax build without the transfer API must warn
+    and leave the counted host-bounce path in place, not crash."""
+    from parsec_tpu.comm import tcp as tcp_mod
+    from parsec_tpu.utils import mca
+
+    monkeypatch.setattr(tcp_mod.XHostTransfer, "available",
+                        staticmethod(lambda: False))
+    mca.set("comm_device_mem", True)
+    try:
+        ce = tcp_mod.TCPCE(0, 1, ("127.0.0.1", 0))   # single rank: no mesh
+        assert ce._xhost is None and ce._xpull is None
+        from parsec_tpu.comm.engine import CAP_ACCELERATOR_MEM
+        assert not (ce.capabilities & CAP_ACCELERATOR_MEM)
+        ce.fini()
+    finally:
+        mca.params.unset("comm_device_mem")
