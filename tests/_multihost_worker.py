@@ -107,6 +107,25 @@ def main():
     print(f"MHMOE pid={pid} err={mo_err:.2e}", flush=True)
     assert mo_err < 1e-4
 
+    # pipeline leg: GPipe microbatches over ALL global devices — one stage
+    # per device, activations hop the ppermute ring across the process
+    # boundary every tick
+    from parsec_tpu.parallel.pipeline import (init_pipeline_params,
+                                              pipeline_forward_stages,
+                                              reference_forward, _mlp_stage)
+    pmesh = Mesh(np.array(jax.devices()), ("pp",))
+    pp_params = init_pipeline_params(3, n, 16)
+    px = np.random.default_rng(8).standard_normal((n, 2, 16)) \
+        .astype(np.float32)
+    p_out = pipeline_forward_stages(
+        {"w": pp_params["w"], "b": pp_params["b"]}, px, _mlp_stage,
+        mesh=pmesh)
+    p_ref = np.asarray(reference_forward(pp_params, px.reshape(-1, 16))
+                       ).reshape(px.shape)
+    pp_err = float(np.abs(np.asarray(p_out) - p_ref).max())
+    print(f"MHPP pid={pid} err={pp_err:.2e} stages={n}", flush=True)
+    assert pp_err < 1e-4
+
     # long-context leg: causal ring attention with the SEQUENCE axis
     # sharded across both controllers — the K/V ppermute ring crosses the
     # process boundary every hop

@@ -47,6 +47,9 @@ def test_two_controller_global_mesh_lm_train_step():
     # per-host input shards assembled into the global batch reproduce the
     # replicated-feed loss exactly
     assert all(re.search(r"MHFEED pid=\d+ diff=", o) for o in outs)
+    # the GPipe activation ring hopped the process boundary too: with
+    # this, every parallelism mode (dp, tp, pp, ep, sp) has crossed it
+    assert all(re.search(r"MHPP pid=\d+ err=", o) for o in outs)
 
     # and the global 2-process run computes the SAME numbers as one
     # process with the same 8-device mesh: the mesh is the program, the
