@@ -52,14 +52,16 @@ def main():
     np.testing.assert_allclose(np.asarray(pout), pref, rtol=2e-5, atol=2e-5)
     print(f"pp: {n}-stage pipeline == sequential")
 
-    # ep: routed MoE
+    # ep: top-2 routed MoE with the Switch aux load-balance loss
     mp = init_moe_params(0, n, 8, 16)
     mx = rng.standard_normal((4 * n, 8)).astype(np.float32)
-    mout = moe_forward(mp, mx)
+    mout, maux = moe_forward(mp, mx, k=2, return_aux=True)
     np.testing.assert_allclose(np.asarray(mout),
-                               np.asarray(dense_reference(mp, mx)),
+                               np.asarray(dense_reference(mp, mx, k=2)),
                                rtol=2e-4, atol=2e-5)
-    print(f"ep: {n} experts over {n} devices == dense routing")
+    print(f"ep: {n} experts over {n} devices, top-2 == dense routing "
+          f"(aux={float(maux['aux_loss']):.2f}, "
+          f"dropped={int(maux['dropped'])})")
 
     # sp: causal ring attention
     q, k, v = (rng.standard_normal((1, 2, 8 * n, 8)).astype(np.float32)
