@@ -7,10 +7,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def maybe_force_cpu() -> None:
-    """Examples run anywhere: fall back to the CPU backend when no healthy
-    accelerator is reachable (EXAMPLES_CPU=1 forces it; the multi-process
-    launcher sets PARSEC_TPU_FORCE_CPU per rank after its single probe)."""
+    """Examples run anywhere: the library's subprocess health probe decides
+    whether a reachable accelerator exists and forces the CPU backend
+    in-process otherwise (a wedged TPU tunnel must degrade within the
+    timeout, not hang the example). EXAMPLES_CPU=1 skips the probe and
+    forces CPU outright; the multi-process launcher sets
+    PARSEC_TPU_FORCE_CPU per rank after its own single probe."""
     if os.environ.get("EXAMPLES_CPU") == "1" \
             or os.environ.get("PARSEC_TPU_FORCE_CPU") == "1":
         import jax
         jax.config.update("jax_platforms", "cpu")
+        return
+    from parsec_tpu.device.probe import decide_backend
+    decide_backend()

@@ -60,6 +60,22 @@ _KIND_BYE = 3        # clean shutdown notice (fini) — EOF after this is
                      # a normal departure, EOF without it is a FAILURE
 
 
+def _is_transport_error(exc: Exception) -> bool:
+    """Is this failure the PEER's (connection/transfer plane) rather than a
+    local fault? OSError covers the socket family (ConnectionError,
+    timeouts); PJRT transfer-plane failures surface as backend RuntimeErrors
+    whose messages carry transport markers rather than a local error class
+    like RESOURCE_EXHAUSTED (which is the consumer's own OOM)."""
+    if isinstance(exc, (OSError, TimeoutError, EOFError)):
+        return True
+    msg = str(exc).upper()
+    if "RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg:
+        return False
+    return any(m in msg for m in (
+        "CONNECT", "UNAVAILABLE", "DEADLINE", "SOCKET", "TRANSFER SERVER",
+        "PEER", "CLOSED", "RESET", "REFUSED", "UNREACHABLE"))
+
+
 def _send_frame(sock: socket.socket, lock: threading.Lock, obj,
                 raw: Optional[memoryview] = None) -> None:
     """Frame = [u32 pickle_len][pickle][u32 raw_len][raw bytes].
@@ -377,7 +393,26 @@ class TCPCE(CommEngine):
                 ref = payload
                 if self._xpull is None:     # pull-only handle: servicing a
                     self._xpull = XHostTransfer()   # peer does NOT enable
-                payload = self._xpull.pull(ref)     # our own send path
+                try:                                # our own send path
+                    payload = self._xpull.pull(ref)
+                except Exception as exc:
+                    # only TRANSPORT-shaped failures mean the producer is
+                    # gone (crashed before the pull / transfer server
+                    # unreachable) — those are attributed like the BYE/EOF
+                    # paths. A local fault (consumer OOM, bad ref) must not
+                    # blame a live peer; it propagates as this rank's error.
+                    if not _is_transport_error(exc):
+                        raise
+                    output.warning(
+                        f"tcp: xhost pull from rank {src} failed "
+                        f"({type(exc).__name__}: {exc}); marking peer dead")
+                    with self._bar_cv:
+                        self.dead_peers.add(src)
+                        self._bar_cv.notify_all()
+                    if self._xhost is not None:
+                        self._xhost.retire_peer(src)
+                    n += 1
+                    continue
                 try:
                     _send_frame(self._peers[src], self._peer_locks[src],
                                 (_KIND_XACK, ref.uuid))

@@ -159,9 +159,12 @@ class XHostTransfer:
         with self._lock:
             conn = self._conns.get(ref.address)
         if conn is None:
-            conn = self._server().connect(ref.address)
+            fresh = self._server().connect(ref.address)
             with self._lock:
-                self._conns[ref.address] = conn
+                # two threads can race to connect; keep exactly one cached
+                # connection per address (the loser's would otherwise leak —
+                # transfer connections are never closed)
+                conn = self._conns.setdefault(ref.address, fresh)
         sds = jax.ShapeDtypeStruct(
             ref.shape, np.dtype(ref.dtype),
             sharding=SingleDeviceSharding(local_device()))

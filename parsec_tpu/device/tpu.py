@@ -534,9 +534,6 @@ def _nbytes(arr) -> int:
         return int(np.prod(getattr(arr, "shape", (1,))) * 4)
 
 
-mca.register("device_discovery_timeout_s", 45,
-             "Give up on accelerator discovery after this many seconds", type=int)
-
 # rank→chip binding handed down by the launcher: index into this process's
 # local device list (ref: the mpiexec + one-GPU-per-rank production shape,
 # tests/CMakeLists.txt:1032-1042)
@@ -550,8 +547,13 @@ def discover_tpu_devices() -> List[TPUDevice]:
 
     Discovery runs under a hard timeout: on TPU pods the first backend touch
     can hang indefinitely when the chip transport is unhealthy; a wedged
-    discovery must degrade to CPU instead of hanging the whole runtime.
+    discovery must degrade to CPU instead of hanging the whole runtime. The
+    first line of defense is the subprocess health probe (`probe.py`) BEFORE
+    any in-process backend touch — the in-thread timeout below only covers
+    the residual race where a backend was initialized behind our back.
     """
+    from .probe import decide_backend
+    decide_backend()
     import jax
     result: List[TPUDevice] = []
     done = threading.Event()

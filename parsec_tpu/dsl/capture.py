@@ -56,6 +56,13 @@ _PROGRAM_CACHE_MAX = 64
 _program_cache: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
 _cache_lock = threading.Lock()
 
+#: memoized dtype-gate verdicts (None = compatible, str = reject reason):
+#: the gate re-traces bodies abstractly per flush otherwise, even when the
+#: scan executable itself is a cache hit. Keyed like the program cache
+#: (body function identity + slots + store geometry), LRU-bounded.
+_dtype_gate_cache: "collections.OrderedDict[Any, Optional[str]]" = \
+    collections.OrderedDict()
+
 
 class GraphCapture:
     """Recorder + compiler for a captured DTD taskpool.
@@ -197,8 +204,10 @@ class GraphCapture:
           into the class (two ops differing in a scalar are two classes);
         * ``rows``     — per op: (class_id, [store slot per flow]).
         """
+        self._scan_reject: Optional[str] = None
         store_ix: Dict[Tuple, int] = {}
         stores: List[List[int]] = []
+        store_meta: List[Tuple[Tuple, Any]] = []   # sid -> (shape, dtype)
         tile_loc: List[Tuple[int, int]] = []
         for i, v in enumerate(tile_vals):
             key = (tuple(np.shape(v)), str(getattr(v, "dtype", type(v))))
@@ -206,6 +215,8 @@ class GraphCapture:
             if sid is None:
                 sid = store_ix[key] = len(stores)
                 stores.append([])
+                store_meta.append((tuple(np.shape(v)),
+                                   getattr(v, "dtype", None)))
             tile_loc.append((sid, len(stores[sid])))
             stores[sid].append(i)
 
@@ -225,6 +236,7 @@ class GraphCapture:
                 elif e[0] == "scalar":
                     slots.append(("scalar", e[1]))
                 else:
+                    self._scan_reject = "raw-array arguments"
                     return None          # raw-array args: not scannable
             ckey = (fn, tuple(slots))
             cid = class_ix.get(ckey)
@@ -232,7 +244,71 @@ class GraphCapture:
                 cid = class_ix[ckey] = len(classes)
                 classes.append((fn, tuple(slots)))
             rows.append((cid, flow_slots))
+
+        # dtype-compatibility gate: inline lands whatever dtype the body
+        # RETURNS; the scan interpreter lands into the store, whose dtype is
+        # the tile's INPUT dtype. A body that upcasts (f16 tiles -> f32
+        # result) would silently round-trip intermediates through f16 every
+        # step under scan — a precision change that must not depend on which
+        # strategy 'auto' picks. Detect it abstractly (no FLOPs) per class
+        # and reject scan so auto falls back to inline.
+        for fn, slots in classes:
+            reject = self._dtype_gate(fn, slots, store_meta)
+            if reject is not None:
+                self._scan_reject = reject
+                return None
         return stores, tile_loc, classes, rows
+
+    @staticmethod
+    def _dtype_gate(fn, slots, store_meta) -> Optional[str]:
+        """None if ``fn``'s written outputs land their stores' dtypes;
+        otherwise the reject reason. Memoized — the abstract trace depends
+        only on (fn, slots, store geometry), not on this flush's values."""
+        key = (fn, slots,
+               tuple(store_meta[sd[2]] for sd in slots if sd[0] == "flow"))
+        with _cache_lock:
+            if key in _dtype_gate_cache:
+                _dtype_gate_cache.move_to_end(key)
+                return _dtype_gate_cache[key]
+
+        import jax
+        from .dtd import WRITE
+        args, wstores = [], []
+        for sd in slots:
+            if sd[0] == "flow":
+                _, fp, sid, acc = sd
+                shape, dt = store_meta[sid]
+                args.append(jax.ShapeDtypeStruct(shape, dt))
+                if acc & WRITE:
+                    wstores.append(sid)
+            else:
+                args.append(sd[1])
+        reject: Optional[str] = None
+        try:
+            out = jax.eval_shape(fn, *args)
+        except Exception as e:  # noqa: BLE001 — conservative: inline can
+            reject = (f"body {fn!r} not abstractly "
+                      f"evaluable ({type(e).__name__})")
+            out = None                   # still trace what scan cannot plan
+        if reject is None:
+            if out is None:
+                outs: Tuple = ()
+            elif not isinstance(out, (tuple, list)):
+                outs = (out,)
+            else:
+                outs = tuple(out)
+            for sid, o in zip(wstores, outs):
+                if np.dtype(o.dtype) != np.dtype(store_meta[sid][1]):
+                    reject = (
+                        f"body {getattr(fn, '__name__', fn)!r} returns "
+                        f"{o.dtype} into a {store_meta[sid][1]} store — "
+                        f"scan would silently cast; use inline")
+                    break
+        with _cache_lock:
+            _dtype_gate_cache[key] = reject
+            while len(_dtype_gate_cache) > _PROGRAM_CACHE_MAX:
+                _dtype_gate_cache.popitem(last=False)
+        return reject
 
     def _build_scan(self, classes):
         """The scanned-interpreter program: one lax.scan over descriptor
@@ -360,12 +436,23 @@ class GraphCapture:
         if mode == "auto":
             if len(self.ops) >= mca.get("capture_scan_threshold", 64):
                 plan = self._scan_plan(tile_vals)
+                if plan is None:
+                    output.debug_verbose(
+                        1, "capture", "auto: scan rejected ("
+                        + (getattr(self, "_scan_reject", None) or "?")
+                        + "); falling back to inline replay")
             mode = "scan" if plan is not None else "inline"
         elif mode == "scan":
             plan = self._scan_plan(tile_vals)
             if plan is None:
-                output.fatal("scan capture requires class-uniform ops "
-                             "(no raw-array arguments)")
+                # deterministic config error: consume the batch FIRST so
+                # close()/fini() don't re-raise or hang on the open action
+                self.ops = []
+                self._tiles = []
+                self._tile_ix = {}
+                output.fatal("scan capture rejected: "
+                             + (getattr(self, "_scan_reject", None)
+                                or "recording is not scannable"))
         self.last_mode = mode
         if mode == "scan":
             written, results = self._execute_scan(tile_vals, plan)

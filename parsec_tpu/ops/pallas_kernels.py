@@ -70,11 +70,20 @@ def _interpret() -> bool:
 _warned_fallbacks: set = set()
 
 
-def _fallback(kernel_name: str, err: Exception) -> None:
+def _fallback(kernel_name: str, err, reason: str = None) -> None:
     """A Pallas failure must never be invisible: strict mode re-raises
     (the CI compile gate), default mode warns ONCE per kernel before the
-    XLA fallback runs."""
+    XLA fallback runs. ``err=None`` with a ``reason`` marks a deliberate
+    shape-based routing decision (not a failure) — never a strict-mode
+    error, but still warned once so the path is visible."""
     from ..utils import mca, output
+    if err is None:
+        key = f"{kernel_name}:routed"
+        if key not in _warned_fallbacks:
+            _warned_fallbacks.add(key)
+            output.warning(f"pallas kernel {kernel_name!r} routed to XLA: "
+                           f"{reason}")
+        return
     if mca.get("pallas_strict", False):
         raise RuntimeError(
             f"pallas kernel {kernel_name!r} failed to lower/run "
@@ -432,13 +441,8 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
 
     bq = _divisor_block(sq, block_q)
     bk = _divisor_block(sk, block_k)
-    try:
-        out = _flash_attn_call(bhn, sq, sk, d, bq, bk, bool(causal),
-                               float(scale), int(q_offset), int(k_offset),
-                               str(q.dtype), _interpret(),
-                               tuple(vma) if vma else None)(q4, k4, v4)
-    except Exception as e:  # noqa: BLE001
-        _fallback("flash_attention", e)
+
+    def _dense(q4, k4, v4):
         import jax
         s = jnp.einsum("bqd,bkd->bqk", q4.astype(jnp.float32),
                        k4.astype(jnp.float32),
@@ -453,6 +457,26 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - jnp.where(
             jnp.isfinite(m), m, 0.0)), 0.0)
         l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
-        out = jnp.einsum("bqk,bkd->bqd", p / l, v4.astype(jnp.float32)
-                         ).astype(q.dtype)
+        return jnp.einsum("bqk,bkd->bqd", p / l, v4.astype(jnp.float32)
+                          ).astype(q.dtype)
+
+    # A prime/odd sequence length degrades the largest divisor toward 1,
+    # which is below TPU tile granularity — a severe Pallas perf cliff or a
+    # Mosaic trace failure. Below _MIN_BLOCK (unless the block IS the whole
+    # sequence), the dense XLA path is the better program: take it
+    # deliberately, not via the exception fallback.
+    _MIN_BLOCK = 8
+    if (bq < _MIN_BLOCK < sq) or (bk < _MIN_BLOCK < sk):
+        _fallback("flash_attention", None,
+                  reason=f"block degenerated (bq={bq}, bk={bk}) for seq "
+                         f"lens ({sq}, {sk}); dense XLA path is faster")
+        return _dense(q4, k4, v4).reshape(q.shape)
+    try:
+        out = _flash_attn_call(bhn, sq, sk, d, bq, bk, bool(causal),
+                               float(scale), int(q_offset), int(k_offset),
+                               str(q.dtype), _interpret(),
+                               tuple(vma) if vma else None)(q4, k4, v4)
+    except Exception as e:  # noqa: BLE001
+        _fallback("flash_attention", e)
+        out = _dense(q4, k4, v4)
     return out.reshape(q.shape)

@@ -679,3 +679,62 @@ def test_scan_capture_multi_write_flows(ctx):
     # step1: a=20, b=3; step2: a=6, b=60
     np.testing.assert_allclose(np.asarray(ta.data.newest_copy().payload), 6.0)
     np.testing.assert_allclose(np.asarray(tb.data.newest_copy().payload), 60.0)
+
+
+def test_scan_rejects_dtype_mismatch_auto_falls_back_to_inline(ctx):
+    """ADVICE r4 (medium): a body upcasting its f16 tile to f32 must land
+    f32 under EVERY strategy — scan would silently round-trip through f16,
+    so the planner rejects it and auto takes inline."""
+    from parsec_tpu.utils import mca
+
+    def upcast(a):
+        return a.astype(np.float32) * 1.5
+
+    mca.set("capture_scan_threshold", 2)   # force auto into scan territory
+    try:
+        cap = DTDTaskpool(ctx, "zdt", capture="auto")
+        t = cap.tile_new((4, 4), np.float16)
+        t.data.create_copy(0, np.full((4, 4), 2.0, np.float16))
+        for _ in range(4):
+            cap.insert_task(upcast, (t, RW))
+        cap.wait()
+        assert cap._capture.last_mode == "inline"
+        cap.close()
+        ctx.wait(timeout=30)
+        out = np.asarray(t.data.newest_copy().payload)
+        assert out.dtype == np.float32          # inline semantics preserved
+        np.testing.assert_allclose(out, 2.0 * 1.5 ** 4)
+    finally:
+        mca.params.unset("capture_scan_threshold")
+
+
+def test_scan_explicit_mode_rejects_dtype_mismatch(ctx):
+    """Explicit capture='scan' with a dtype-changing body is an error, not
+    a silent cast (f16 -> f32: a real change without x64 enabled)."""
+    def upcast(a):
+        return a.astype(np.float32)
+
+    cap = DTDTaskpool(ctx, "zdx", capture="scan")
+    t = cap.tile_new((4, 4), np.float16)
+    t.data.create_copy(0, np.ones((4, 4), np.float16))
+    cap.insert_task(upcast, (t, RW))
+    with pytest.raises(Exception, match="scan capture rejected.*float32"):
+        cap.wait()
+    cap.close()
+
+
+def test_scan_matching_dtypes_still_scans(ctx):
+    """The dtype gate must not regress the scannable case."""
+    def scale(a):
+        return a * 2.0
+
+    cap = DTDTaskpool(ctx, "zok", capture="scan")
+    t = cap.tile_new((4, 4), np.float32)
+    t.data.create_copy(0, np.ones((4, 4), np.float32))
+    for _ in range(3):
+        cap.insert_task(scale, (t, RW))
+    cap.wait()
+    assert cap._capture.last_mode == "scan"
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 8.0)

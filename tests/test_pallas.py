@@ -194,3 +194,29 @@ def test_flash_attention_unaligned_offset_masked_rows():
     ref = _dense_attn(q, k, v, causal=True, q_off=0, k_off=32)
     assert np.all(np.abs(out[:, :32]) < 1e-6)          # fully masked rows
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_prime_seq_routes_to_dense():
+    """ADVICE r4: a prime sequence length (257) degrades the largest
+    divisor block toward 1 — below tile granularity the dense XLA path is
+    taken DELIBERATELY (not via the exception fallback) and must still be
+    numerically correct."""
+    rng = np.random.default_rng(41)
+    q = rng.standard_normal((1, 257, 16)).astype(np.float32)
+    k = rng.standard_normal((1, 257, 16)).astype(np.float32)
+    v = rng.standard_normal((1, 257, 16)).astype(np.float32)
+    out = np.asarray(PK.flash_attention(q, k, v, causal=True))
+    ref = _dense_attn(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_small_seq_still_uses_pallas_path():
+    """A short sequence (s < MIN_BLOCK) is a single whole-sequence block —
+    viable, so the deliberate-routing gate must NOT trip."""
+    rng = np.random.default_rng(42)
+    q = rng.standard_normal((1, 4, 16)).astype(np.float32)
+    k = rng.standard_normal((1, 4, 16)).astype(np.float32)
+    v = rng.standard_normal((1, 4, 16)).astype(np.float32)
+    out = np.asarray(PK.flash_attention(q, k, v))
+    ref = _dense_attn(q, k, v)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)

@@ -98,3 +98,31 @@ def test_tcpce_flag_on_but_transfer_unavailable_warns_and_bounces(monkeypatch):
         ce.fini()
     finally:
         mca.params.unset("comm_device_mem")
+
+
+def test_tcpce_pull_failure_attributes_peer_not_crash(monkeypatch):
+    """ADVICE r4: a rendezvous pull that raises (producer crashed before
+    the pull / transfer server unreachable) must be attributed as a dead
+    peer — mirroring the BYE/EOF paths — not crash the progress driver."""
+    from parsec_tpu.comm import tcp as tcp_mod
+    from parsec_tpu.comm.engine import TAG_DSL_BASE
+    from parsec_tpu.comm.xhost import XHostRef
+
+    ce = tcp_mod.TCPCE(0, 1, ("127.0.0.1", 0))   # single rank: no mesh
+    try:
+        class _BoomPull:
+            def pull(self, ref):
+                raise ConnectionRefusedError("transfer server gone")
+        ce._xpull = _BoomPull()
+        delivered = []
+        ce.tag_register(TAG_DSL_BASE,
+                        lambda _ce, src, hdr, pl: delivered.append(pl))
+        ref = XHostRef(uuid=7, address="127.0.0.1:1", shape=(2,),
+                       dtype="float32")
+        ce._inbound.append((TAG_DSL_BASE, 3, {"h": 1}, ref))
+        n = ce.progress()                         # must NOT raise
+        assert n == 1
+        assert 3 in ce.dead_peers                 # failure attributed
+        assert delivered == []                    # message dead-lettered
+    finally:
+        ce.fini()
