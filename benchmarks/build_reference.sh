@@ -1,0 +1,126 @@
+#!/usr/bin/env bash
+# Build the PaRSEC reference (CPU-only, no MPI/hwloc/CUDA) for the
+# head-to-head microbenchmarks (VERDICT r4 next-round #1).
+#
+# The reference does NOT build with hwloc absent — parsec.c:829,
+# parsec_hwloc.c:386/486 and vpmap.c:153/409 call hwloc unguarded, and
+# parsec_hwloc.h defines no no-hwloc fallbacks for the HWLOC_* macros.
+# We therefore shadow-copy the tree to /tmp (the reference itself is
+# read-only and must stay untouched) and apply four minimal
+# #if-defined(PARSEC_HAVE_HWLOC) guards before building. The patches touch
+# GUARDS ONLY — no behavioral code changes, so the benchmark numbers are
+# the reference's own.
+set -euo pipefail
+
+REF=${1:-/root/reference}
+SRC=${2:-/tmp/refsrc}
+BUILD=${3:-/tmp/refbuild}
+
+if [ ! -d "$SRC" ]; then
+  cp -a "$REF" "$SRC"
+  python3 - "$SRC" <<'EOF'
+import sys
+src_dir = sys.argv[1]
+
+def patch(path, old, new):
+    p = f"{src_dir}/{path}"
+    s = open(p).read()
+    assert old in s, f"anchor not found in {path}"
+    open(p, "w").write(s.replace(old, new))
+
+# 1. parsec.c: report-bindings block uses hwloc unguarded
+patch("parsec/parsec.c",
+      "    if( parsec_report_bindings) {\n        char *str;\n"
+      "        hwloc_bitmap_asprintf(&str, context->cpuset_allowed_mask);",
+      "#if defined(PARSEC_HAVE_HWLOC)\n"
+      "    if( parsec_report_bindings) {\n        char *str;\n"
+      "        hwloc_bitmap_asprintf(&str, context->cpuset_allowed_mask);")
+patch("parsec/parsec.c",
+      "        hwloc_bitmap_asprintf(&str, context->cpuset_free_mask);\n"
+      "        parsec_inform(\"Process binding [rank %d]: cpuset [FREE     ]:"
+      " %s\\n\", context->my_rank, str);\n        free(str);\n    }\n",
+      "        hwloc_bitmap_asprintf(&str, context->cpuset_free_mask);\n"
+      "        parsec_inform(\"Process binding [rank %d]: cpuset [FREE     ]:"
+      " %s\\n\", context->my_rank, str);\n        free(str);\n    }\n"
+      "#endif  /* PARSEC_HAVE_HWLOC */\n")
+
+# 2. parsec_hwloc.h: no-hwloc stand-ins for the HWLOC_* macros
+patch("parsec/parsec_hwloc.h",
+      "#endif  /* defined(PARSEC_HAVE_HWLOC_BITMAP) */\n"
+      "#endif  /* defined(PARSEC_HAVE_HWLOC) */",
+      "#endif  /* defined(PARSEC_HAVE_HWLOC_BITMAP) */\n"
+      "#else\n"
+      "#define HWLOC_ASPRINTF(s, c)  (*(s) = NULL, 0)\n"
+      "#define HWLOC_ISSET(c, i)     0\n"
+      "#define HWLOC_SET(c, i)       do {} while(0)\n"
+      "#define HWLOC_FIRST(c)        (-1)\n"
+      "#define HWLOC_WEIGHT(c)       0\n"
+      "#define HWLOC_ALLOC()         0\n"
+      "#define HWLOC_DUP(c)          (c)\n"
+      "#define HWLOC_SINGLIFY(c)     do {} while(0)\n"
+      "#define HWLOC_FREE(c)         do {} while(0)\n"
+      "#define HWLOC_INTERSECTS(a,b) 0\n"
+      "#define HWLOC_OR(d,a,b)       do {} while(0)\n"
+      "#endif  /* defined(PARSEC_HAVE_HWLOC) */")
+
+# 3. parsec_hwloc.c: two functions with unguarded bodies
+patch("parsec/parsec_hwloc.c",
+      "hwloc_cpuset_t parsec_hwloc_cpuset_per_obj(int level, int index)\n{\n",
+      "hwloc_cpuset_t parsec_hwloc_cpuset_per_obj(int level, int index)\n{\n"
+      "#if !defined(PARSEC_HAVE_HWLOC)\n"
+      "    (void)level; (void)index; return 0;\n"
+      "#else\n")
+patch("parsec/parsec_hwloc.c",
+      "    return HWLOC_DUP(obj->cpuset);\n}",
+      "    return HWLOC_DUP(obj->cpuset);\n"
+      "#endif\n}")
+patch("parsec/parsec_hwloc.c",
+      "hwloc_cpuset_t parsec_hwloc_cpuset_convert_to_system(hwloc_cpuset_t"
+      " cpuset)\n{\n",
+      "hwloc_cpuset_t parsec_hwloc_cpuset_convert_to_system(hwloc_cpuset_t"
+      " cpuset)\n{\n"
+      "#if !defined(PARSEC_HAVE_HWLOC)\n"
+      "    return cpuset;\n"
+      "#else\n")
+patch("parsec/parsec_hwloc.c",
+      "    } hwloc_bitmap_foreach_end();\n\n    return binding_mask;\n}",
+      "    } hwloc_bitmap_foreach_end();\n\n    return binding_mask;\n"
+      "#endif\n}")
+patch("parsec/parsec_hwloc.c",
+      "    char *str = NULL;\n\n    if( convert_to_system ) {",
+      "    char *str = NULL;\n#if defined(PARSEC_HAVE_HWLOC)\n"
+      "    if( convert_to_system ) {")
+patch("parsec/parsec_hwloc.c",
+      "        HWLOC_ASPRINTF(&str, cpuset);\n    }\n    return str;\n}",
+      "        HWLOC_ASPRINTF(&str, cpuset);\n    }\n"
+      "#else\n    (void)convert_to_system; (void)cpuset;\n#endif\n"
+      "    return str;\n}")
+
+# 4. vpmap.c: raw hwloc calls outside any guard
+patch("parsec/vpmap.c",
+      "            if( parsec_runtime_singlify_bindings > 0 )  /* late "
+      "singlify */\n                hwloc_bitmap_singlify(parsec_vpmap[i]."
+      "threads[j].cpuset);",
+      "#if defined(PARSEC_HAVE_HWLOC)\n"
+      "            if( parsec_runtime_singlify_bindings > 0 )  /* late "
+      "singlify */\n                hwloc_bitmap_singlify(parsec_vpmap[i]."
+      "threads[j].cpuset);\n#endif")
+patch("parsec/vpmap.c",
+      "        hwloc_bitmap_set_range(parsec_vpmap[0].threads[id].cpuset, "
+      "id * step, (id+1) * step - 1);",
+      "#if defined(PARSEC_HAVE_HWLOC)\n"
+      "        hwloc_bitmap_set_range(parsec_vpmap[0].threads[id].cpuset, "
+      "id * step, (id+1) * step - 1);\n#endif")
+print("reference patched for no-hwloc build")
+EOF
+fi
+
+mkdir -p "$BUILD"
+cd "$BUILD"
+cmake "$SRC" -DCMAKE_BUILD_TYPE=Release -DPARSEC_GPU_WITH_CUDA=OFF \
+      -DPARSEC_DIST_WITH_MPI=OFF -DBUILD_TESTING=ON > cmake_config.log 2>&1
+make -j"$(python3 -c 'import os; print(max(2, os.cpu_count()))')" \
+     > build.log 2>&1 || { tail -30 build.log; exit 1; }
+echo "reference built: $BUILD"
+ls "$BUILD"/tests/runtime/scheduling/schedmicro \
+   "$BUILD"/tests/dsl/dtd/dtd_test_task_insertion

@@ -1,0 +1,337 @@
+// parsec_tpu._ptdtd — the DTD dependency engine as a CPython extension.
+//
+// Stands where the reference's C insert path stands
+// (parsec/interfaces/dtd/insert_function.c:3617 parsec_dtd_insert_task ->
+// parsec_dtd_set_params_of_task insert_function.c:2896 and the release walk
+// parsec_dtd_ordering_correctly, insert_function_internal.h:277): runtime
+// dependency discovery over per-tile last-writer/reader chains, the
+// insertion-guard count-then-activate protocol, and the successor release
+// that collects newly-ready tasks.
+//
+// Why a CPython extension and not ctypes: this is called ONCE PER TASK on
+// the insert and completion hot paths; a ctypes boundary costs ~2 us while
+// a C-extension method call costs ~0.2 us (measured in this container —
+// see parsec_tpu/native.py's docstring for the ctypes numbers).
+//
+// Scope: the SINGLE-RANK engine. Distributed inserts, the replay auditor,
+// and remote version bookkeeping stay in the Python engine (dsl/dtd.py
+// _link_tile) — they are protocol-bound, not insert-rate-bound. The Python
+// side gates which engine a taskpool uses (DTDTaskpool._native_engine).
+//
+// Concurrency: every entry point runs under the GIL (worker threads call
+// complete() from Python), which serializes access; no internal locks.
+// Task/tile records live in growing arrays; ids are indices and are never
+// recycled (a completed task id may persist as a tile's last_writer).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <new>
+#include <vector>
+
+namespace {
+
+constexpr int32_t ACC_READ = 0x1;    // mirrors dsl/dtd.py READ
+constexpr int32_t ACC_WRITE = 0x2;   // mirrors dsl/dtd.py WRITE
+
+struct TaskRec {
+    int32_t deps_remaining = 1;   // the insertion-in-progress guard
+    bool completed = false;
+    uint32_t stamp = 0;           // pred-dedup visit stamp
+    std::vector<int64_t> succs;
+};
+
+struct TileRec {
+    int64_t last_writer = -1;
+    int32_t compact_at = 32;      // reader-list compaction watermark
+    std::vector<int64_t> readers;
+};
+
+struct Engine {
+    PyObject_HEAD
+    std::vector<TaskRec> *tasks;
+    std::vector<TileRec> *tiles;
+    uint32_t stamp;
+    int64_t live;                 // inserted - completed
+};
+
+PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(type->tp_alloc(type, 0));
+    if (!self) return nullptr;
+    self->tasks = new (std::nothrow) std::vector<TaskRec>();
+    self->tiles = new (std::nothrow) std::vector<TileRec>();
+    self->stamp = 0;
+    self->live = 0;
+    if (!self->tasks || !self->tiles) {
+        Py_DECREF(self);
+        PyErr_NoMemory();
+        return nullptr;
+    }
+    return reinterpret_cast<PyObject *>(self);
+}
+
+void engine_dealloc(PyObject *obj) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    delete self->tasks;
+    delete self->tiles;
+    Py_TYPE(obj)->tp_free(obj);
+}
+
+// tile() -> int : register a new tile chain
+PyObject *engine_tile(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    self->tiles->emplace_back();
+    return PyLong_FromSsize_t((Py_ssize_t)self->tiles->size() - 1);
+}
+
+// insert(tile_ids: list|tuple[int], accs: list|tuple[int])
+//   -> (task_id, deps_remaining)   — deps_remaining == 0 means ready
+//
+// Replicates dsl/dtd.py _link_tile single-rank semantics exactly:
+//   READ (or access without WRITE): RAW pred on the live last writer;
+//     the task joins the tile's reader list (amortized compaction of
+//     completed readers past the doubling watermark).
+//   WRITE: WAR preds on live readers, WAW pred on the live last writer;
+//     the tile chain then points at this task and the reader list resets.
+// Preds are deduplicated (visit stamps) and self-edges skipped; each live
+// pred gains a successor edge and bumps this task's dep count. The
+// insertion guard (count starts at 1) drops at the end — "becomes ready
+// exactly once" (ref: parsec_dtd_schedule_task_if_ready,
+// insert_function.c:2963).
+PyObject *engine_insert(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *tile_ids, *accs;
+    if (!PyArg_ParseTuple(args, "OO", &tile_ids, &accs))
+        return nullptr;
+    // lists are what the hot caller builds; accept tuples too
+    const bool til = PyList_Check(tile_ids), acl = PyList_Check(accs);
+    if ((!til && !PyTuple_Check(tile_ids)) ||
+        (!acl && !PyTuple_Check(accs))) {
+        PyErr_SetString(PyExc_TypeError, "tile_ids/accs: list or tuple");
+        return nullptr;
+    }
+    Py_ssize_t nflows = til ? PyList_GET_SIZE(tile_ids)
+                            : PyTuple_GET_SIZE(tile_ids);
+    if ((acl ? PyList_GET_SIZE(accs) : PyTuple_GET_SIZE(accs)) != nflows) {
+        PyErr_SetString(PyExc_ValueError, "tile_ids/accs length mismatch");
+        return nullptr;
+    }
+
+    std::vector<TaskRec> &tasks = *self->tasks;
+    std::vector<TileRec> &tiles = *self->tiles;
+    const int64_t tid = (int64_t)tasks.size();
+    tasks.emplace_back();
+    self->live++;
+    // note: emplace may reallocate; take references AFTER any growth
+    if (++self->stamp == 0) {     // stamp wrapped: clear all (rare)
+        for (auto &t : tasks) t.stamp = 0;
+        self->stamp = 1;
+    }
+    const uint32_t stamp = self->stamp;
+    int32_t new_deps = 0;
+
+    for (Py_ssize_t i = 0; i < nflows; i++) {
+        int64_t tix = PyLong_AsLongLong(
+            til ? PyList_GET_ITEM(tile_ids, i)
+                : PyTuple_GET_ITEM(tile_ids, i));
+        long acc = PyLong_AsLong(acl ? PyList_GET_ITEM(accs, i)
+                                     : PyTuple_GET_ITEM(accs, i));
+        if ((tix < 0 || (size_t)tix >= tiles.size()) && !PyErr_Occurred())
+            PyErr_SetString(PyExc_IndexError, "bad tile id");
+        if (PyErr_Occurred()) {
+            tasks.pop_back();
+            self->live--;
+            return nullptr;
+        }
+        TileRec &tile = tiles[(size_t)tix];
+        const bool is_read = (acc & ACC_READ) || !(acc & ACC_WRITE);
+        if (is_read) {
+            int64_t lw = tile.last_writer;
+            if (lw >= 0 && !tasks[(size_t)lw].completed &&
+                lw != tid && tasks[(size_t)lw].stamp != stamp) {
+                tasks[(size_t)lw].stamp = stamp;
+                tasks[(size_t)lw].succs.push_back(tid);
+                new_deps++;
+            }
+            if (!(acc & ACC_WRITE)) {   // pure READ joins the reader list
+                if ((int32_t)tile.readers.size() >= tile.compact_at) {
+                    size_t w = 0;       // prune completed readers in place
+                    for (size_t r = 0; r < tile.readers.size(); r++)
+                        if (!tasks[(size_t)tile.readers[r]].completed)
+                            tile.readers[w++] = tile.readers[r];
+                    tile.readers.resize(w);
+                    int32_t dbl = 2 * (int32_t)(w + 1);
+                    tile.compact_at = dbl > 32 ? dbl : 32;
+                }
+                tile.readers.push_back(tid);
+            }
+        }
+        if (acc & ACC_WRITE) {
+            if (acc & ACC_READ) {       // RW also joined RAW above; reader
+                // list membership is superseded by becoming the writer
+            }
+            for (int64_t r : tile.readers) {
+                if (r == tid) continue;
+                TaskRec &rr = tasks[(size_t)r];
+                if (!rr.completed && rr.stamp != stamp) {
+                    rr.stamp = stamp;
+                    rr.succs.push_back(tid);
+                    new_deps++;
+                }
+            }
+            int64_t lw = tile.last_writer;
+            if (lw >= 0 && lw != tid) {
+                TaskRec &lwr = tasks[(size_t)lw];
+                if (!lwr.completed && lwr.stamp != stamp) {
+                    lwr.stamp = stamp;
+                    lwr.succs.push_back(tid);
+                    new_deps++;
+                }
+            }
+            tile.last_writer = tid;
+            tile.readers.clear();
+            tile.compact_at = 32;
+        }
+    }
+
+    TaskRec &rec = tasks[(size_t)tid];
+    rec.deps_remaining += new_deps;
+    --rec.deps_remaining;                            // drop insertion guard
+    return Py_BuildValue("(Li)", (long long)tid, (int)rec.deps_remaining);
+}
+
+// complete(task_id) -> tuple of newly-ready task ids (often empty)
+PyObject *engine_complete(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    int64_t tid = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return nullptr;
+    std::vector<TaskRec> &tasks = *self->tasks;
+    if (tid < 0 || (size_t)tid >= tasks.size()) {
+        PyErr_SetString(PyExc_IndexError, "bad task id");
+        return nullptr;
+    }
+    TaskRec &rec = tasks[(size_t)tid];
+    if (rec.completed) {
+        PyErr_SetString(PyExc_RuntimeError, "task completed twice");
+        return nullptr;
+    }
+    rec.completed = true;
+    self->live--;
+    // move out the successor list so the record sheds its heap storage
+    std::vector<int64_t> succs;
+    succs.swap(rec.succs);
+    int64_t ready[64];
+    size_t nready = 0;
+    PyObject *out = nullptr;
+    for (int64_t s : succs) {
+        TaskRec &sr = tasks[(size_t)s];
+        if (--sr.deps_remaining == 0) {
+            if (nready < 64) {
+                ready[nready++] = s;
+            } else {
+                // very wide release: spill into the tuple path
+                if (!out) {
+                    out = PyList_New(0);
+                    if (!out) return nullptr;
+                    for (size_t i = 0; i < nready; i++) {
+                        PyObject *v = PyLong_FromLongLong(ready[i]);
+                        if (!v || PyList_Append(out, v) < 0) {
+                            Py_XDECREF(v); Py_DECREF(out); return nullptr;
+                        }
+                        Py_DECREF(v);
+                    }
+                }
+                PyObject *v = PyLong_FromLongLong(s);
+                if (!v || PyList_Append(out, v) < 0) {
+                    Py_XDECREF(v); Py_DECREF(out); return nullptr;
+                }
+                Py_DECREF(v);
+            }
+        }
+    }
+    if (out) {
+        PyObject *tup = PyList_AsTuple(out);
+        Py_DECREF(out);
+        return tup;
+    }
+    PyObject *tup = PyTuple_New((Py_ssize_t)nready);
+    if (!tup) return nullptr;
+    for (size_t i = 0; i < nready; i++) {
+        PyObject *v = PyLong_FromLongLong(ready[i]);
+        if (!v) { Py_DECREF(tup); return nullptr; }
+        PyTuple_SET_ITEM(tup, (Py_ssize_t)i, v);
+    }
+    return tup;
+}
+
+// deps_remaining(task_id) -> int  (diagnostics / paranoid checks)
+PyObject *engine_deps_remaining(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    int64_t tid = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return nullptr;
+    if (tid < 0 || (size_t)tid >= self->tasks->size()) {
+        PyErr_SetString(PyExc_IndexError, "bad task id");
+        return nullptr;
+    }
+    return PyLong_FromLong((*self->tasks)[(size_t)tid].deps_remaining);
+}
+
+PyObject *engine_pending(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    return PyLong_FromLongLong(self->live);
+}
+
+PyObject *engine_sizes(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    return Py_BuildValue("(nn)", (Py_ssize_t)self->tasks->size(),
+                         (Py_ssize_t)self->tiles->size());
+}
+
+PyMethodDef engine_methods[] = {
+    {"tile", engine_tile, METH_NOARGS,
+     "register a tile chain; returns its id"},
+    {"insert", engine_insert, METH_VARARGS,
+     "insert(tile_ids, accs) -> (task_id, deps_remaining)"},
+    {"complete", engine_complete, METH_O,
+     "complete(task_id) -> tuple of newly-ready task ids"},
+    {"deps_remaining", engine_deps_remaining, METH_O,
+     "deps_remaining(task_id) -> int"},
+    {"pending", engine_pending, METH_NOARGS,
+     "live (incomplete) task count"},
+    {"sizes", engine_sizes, METH_NOARGS,
+     "(total tasks ever, total tiles) — memory diagnostics"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject EngineType = [] {
+    PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+    t.tp_name = "parsec_tpu._ptdtd.Engine";
+    t.tp_basicsize = sizeof(Engine);
+    t.tp_flags = Py_TPFLAGS_DEFAULT;
+    t.tp_doc = "single-rank DTD dependency engine (native hot path)";
+    t.tp_new = engine_new;
+    t.tp_dealloc = engine_dealloc;
+    t.tp_methods = engine_methods;
+    return t;
+}();
+
+PyModuleDef ptdtd_module = {
+    PyModuleDef_HEAD_INIT, "_ptdtd",
+    "native DTD dependency engine (see native/src/ptdtd.cpp)", -1,
+    nullptr, nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__ptdtd(void) {
+    if (PyType_Ready(&EngineType) < 0) return nullptr;
+    PyObject *m = PyModule_Create(&ptdtd_module);
+    if (!m) return nullptr;
+    Py_INCREF(&EngineType);
+    if (PyModule_AddObject(m, "Engine",
+                           reinterpret_cast<PyObject *>(&EngineType)) < 0) {
+        Py_DECREF(&EngineType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

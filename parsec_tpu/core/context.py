@@ -158,6 +158,11 @@ class Context:
         self._workers: List[threading.Thread] = []
         self._work_event = threading.Event()
         self._error: Optional[BaseException] = None
+        self._prio_seen = False   # any nonzero-priority task ever scheduled
+        #: callables invoked when a progress loop starts or starves —
+        #: producers holding amortization buffers (the DTD ready batch)
+        #: drain here so direct _progress_loop users see their tasks
+        self._drain_hooks: List = []
         # per-thread stream binding (was a thread-NAME parse on every
         # schedule() — the single hottest line of the EP profile)
         self._tls = threading.local()
@@ -303,6 +308,14 @@ class Context:
                 if t.status == TASK_STATUS_COMPLETE:
                     output.fatal(f"PARANOID: completed task {t!r} "
                                  f"re-scheduled")
+        if not self._prio_seen:
+            # burst selection is only policy-sound while every live task
+            # has equal priority: the first prioritized task flips the hot
+            # loop to task-at-a-time selects so releases preempt promptly
+            for t in tasks:
+                if t.priority:
+                    self._prio_seen = True
+                    break
         stream = stream or self._current_stream()
         if self.pins.enabled:
             self.pins.fire(pins_mod.SCHEDULE_BEGIN, stream, tasks)
@@ -317,6 +330,7 @@ class Context:
         # threadlocal binding (workers bind in _worker_main); unknown
         # threads (user code, comm thread) act as the master stream
         return getattr(self._tls, "stream", None) or self.streams[0]
+
 
     # ------------------------------------------------------------------ hot loop
     def _worker_main(self, stream: ExecutionStream) -> None:
@@ -335,6 +349,8 @@ class Context:
         misses = 0
         deadline = None if timeout is None else time.monotonic() + timeout
         backoff_max = mca.get("runtime_backoff_max_us", 1000) / 1e6
+        for h in tuple(self._drain_hooks):
+            h()
         while not until():
             if self._error is not None:
                 if stream.is_master:
@@ -359,32 +375,66 @@ class Context:
                 stream.nb_selects += 1
             if task is not None:
                 misses = 0
+                # drain a burst before re-checking the loop conditions: the
+                # per-iteration overhead (until, error, comm, device polls)
+                # is pure cost for fine-grain tasks, and the scheduler pops
+                # the whole burst under ONE lock (select_burst). Bursts
+                # skip the SELECT pins events, so instrumentation keeps the
+                # task-at-a-time shape
+                budget = 1 if self.pins.enabled else 32
+                use_burst = not (self.pins.enabled or self._prio_seen)
+                batch: List[Task] = []
+                bi = 0
                 try:
-                    # drain a small burst before re-checking the loop
-                    # conditions: the per-iteration overhead (until, error,
-                    # comm, device polls) is pure cost for fine-grain tasks.
-                    # Burst selects skip the SELECT pins events, so the
-                    # burst collapses to 1 while instrumentation is on
-                    budget = 1 if self.pins.enabled else 32
                     while True:
                         self._task_progress(stream, task, distance)
                         budget -= 1
+                        task = stream.next_task
+                        if task is not None:
+                            if budget <= 0:
+                                # outer loop consumes next_task; un-run
+                                # burst tasks go back to the queues
+                                if bi < len(batch):
+                                    self.sched.schedule(stream, batch[bi:], 0)
+                                break
+                            stream.next_task = None
+                            distance = 0
+                            continue
+                        if bi < len(batch):
+                            task = batch[bi]
+                            bi += 1
+                            distance = 0
+                            continue
                         if budget <= 0:
                             break
-                        task = stream.next_task
-                        stream.next_task = None
-                        distance = 0
-                        if task is None:
+                        if use_burst:
+                            batch = self.sched.select_burst(stream, budget)
+                            stream.nb_selects += 1
+                            bi = 0
+                            if not batch:
+                                break
+                            task = batch[0]
+                            bi = 1
+                        else:
+                            # prioritized workload: task-at-a-time selects
+                            # keep just-released high-priority work first
                             task, distance = self.sched.select(stream)
                             stream.nb_selects += 1
                             if task is None:
                                 break
+                            continue
+                        distance = 0
                 except BaseException as e:  # noqa: BLE001
                     # a failing body must surface to every waiter, not die
                     # silently with one worker thread (ref: hook errors are
                     # fatal, scheduling.c:541-548)
                     if self._error is None:
                         self._error = e
+                    if bi < len(batch):     # un-run burst tasks stay queued
+                        try:
+                            self.sched.schedule(stream, batch[bi:], 0)
+                        except Exception:
+                            pass
                     self._work_event.set()
                     if stream.is_master:
                         raise
@@ -392,6 +442,8 @@ class Context:
                 did_something = True
             if not did_something:
                 misses += 1
+                for h in tuple(self._drain_hooks):   # starving: drain any
+                    h()                              # amortization buffers
                 if deadline is not None and time.monotonic() > deadline:
                     return
                 # exponential backoff while starving (ref: scheduling.c:801-804)
@@ -402,6 +454,13 @@ class Context:
                        distance: int = 0) -> int:
         """__parsec_task_progress (ref: scheduling.c:507)."""
         tc = task.task_class
+        if getattr(task, "nid", -1) >= 0 and not self.pins.enabled \
+                and not self.paranoid and tc.fast_inline and not tc.jit_ok:
+            # DTD native fast lane: eager CPU body, synchronous completion
+            # — one fused call replaces the prepare/execute/complete FSM
+            # (instrumented runs keep the full cycle for event symmetry)
+            task.taskpool._lean_cycle(stream, task)
+            return HOOK_DONE
         if task.status < TASK_STATUS_PREPARE_INPUT:
             task.status = TASK_STATUS_PREPARE_INPUT
             pins_on = self.pins.enabled
@@ -553,7 +612,8 @@ class Context:
         # publish every flow that local successors will consume — written
         # flows and forwarded reads alike (count_deps_fct role, parsec.c:1448)
         wants_repo = repo is not None and any(
-            any(d.task_class is not None for d in f.deps_out) for f in tc.flows)
+            any(d.task_class is not None for d in f.deps_out)
+            for f in tc.flows if not (f.access & FLOW_ACCESS_CTL))
         entry = None
         nb_uses = 0
         if wants_repo:

@@ -31,6 +31,7 @@ data locality (which tiles stay HBM-resident), not CPU load balance.
 from __future__ import annotations
 
 import bisect
+import operator
 import heapq
 import itertools
 import random
@@ -63,6 +64,19 @@ class SchedulerModule:
     def select(self, stream) -> Tuple[Optional[Task], int]:
         """Return (task, distance-it-came-from) or (None, 0)."""
         raise NotImplementedError
+
+    def select_burst(self, stream, n: int) -> List[Task]:
+        """Pop up to ``n`` tasks in policy order. Default: loop select().
+        Queue-backed modules override with a single-lock bulk pop — the
+        per-call overhead an interpreted hot loop cannot amortize one task
+        at a time."""
+        out = []
+        for _ in range(n):
+            t, _d = self.select(stream)
+            if t is None:
+                break
+            out.append(t)
+        return out
 
     def stats(self, stream) -> Dict[str, int]:
         return {}
@@ -181,19 +195,33 @@ class _LockedHeap:
         return len(self.heap)
 
 
+_PRIO_KEY = operator.attrgetter("priority")
+
+
 class _HBBuffer:
     """Hierarchical bounded buffer (redesign of parsec/hbbuffer.c:1-278):
     fixed capacity; overflow spills through ``parent_push`` (another buffer
     or the system dequeue); ``pop_best`` removes the highest-priority
-    element, ``pop_any`` the coldest (steal end)."""
+    element, ``pop_any`` the coldest (steal end).
 
-    __slots__ = ("cap", "items", "lock", "parent_push")
+    Ordering is LAZY: pushes only mark the buffer dirty and the sort runs
+    at the next pop — bulk producers (the DTD ready batch) would otherwise
+    pay a full re-sort per push. Timsort makes the all-equal-priority case
+    (the common one) a single O(n) scan."""
+
+    __slots__ = ("cap", "items", "lock", "parent_push", "_dirty")
 
     def __init__(self, cap: int, parent_push) -> None:
         self.cap = max(1, cap)
         self.items: List[Task] = []     # ascending priority; best at the end
         self.lock = threading.Lock()
         self.parent_push = parent_push
+        self._dirty = False
+
+    def _ensure_sorted(self) -> None:   # call with self.lock held
+        if self._dirty:
+            self.items.sort(key=_PRIO_KEY)
+            self._dirty = False
 
     def push(self, tasks: List[Task]) -> None:
         """Fill to capacity, spill the rest upward (hbbuffer_push_all)."""
@@ -202,7 +230,7 @@ class _HBBuffer:
             take, spill = tasks[:room], tasks[room:]
             if take:
                 self.items.extend(take)
-                self.items.sort(key=lambda t: t.priority)
+                self._dirty = True
         if spill:
             self.parent_push(spill)
 
@@ -211,7 +239,8 @@ class _HBBuffer:
         (hbbuffer_push_all_by_priority): hot tasks stay local."""
         with self.lock:
             self.items.extend(tasks)
-            self.items.sort(key=lambda t: t.priority)
+            self.items.sort(key=_PRIO_KEY)
+            self._dirty = False
             nspill = len(self.items) - self.cap
             spill, self.items = (self.items[:nspill], self.items[nspill:]) \
                 if nspill > 0 else ([], self.items)
@@ -220,11 +249,30 @@ class _HBBuffer:
 
     def pop_best(self) -> Optional[Task]:
         with self.lock:
-            return self.items.pop() if self.items else None
+            if not self.items:
+                return None
+            self._ensure_sorted()
+            return self.items.pop()
+
+    def pop_best_burst(self, n: int) -> List[Task]:
+        """Up to ``n`` highest-priority items, one lock."""
+        with self.lock:
+            items = self.items
+            k = min(n, len(items))
+            if not k:
+                return []
+            self._ensure_sorted()
+            batch = items[-k:]
+            del items[-k:]
+        batch.reverse()          # best first
+        return batch
 
     def pop_any(self) -> Optional[Task]:
         with self.lock:
-            return self.items.pop(0) if self.items else None
+            if not self.items:
+                return None
+            self._ensure_sorted()
+            return self.items.pop(0)
 
     def __len__(self) -> int:
         return len(self.items)
@@ -299,7 +347,12 @@ class SchedLFQ(_LocalQueuesBase):
     priority = 20
 
     def flow_init(self, stream) -> None:
-        cap = 4 * max(1, len(self.context.streams))
+        # bounded per-stream buffers exist to keep work stealable: with ONE
+        # stream there is nobody to steal, so spilling to the system deque
+        # (and walking the empty steal order on every select) is pure cost
+        # — the local buffer absorbs everything
+        ns = len(self.context.streams)
+        cap = 4 * ns if ns > 1 else (1 << 30)
         with self._init_lock:
             self._queues[stream.th_id] = _HBBuffer(cap, self._system_push)
             self._order.append(stream.th_id)
@@ -322,6 +375,12 @@ class SchedLFQ(_LocalQueuesBase):
             if t is not None:
                 return t, d
         return self._system.pop_front(), len(self._order)
+
+    def select_burst(self, stream, n: int):
+        batch = self._local(stream).pop_best_burst(n)
+        if batch:
+            return batch
+        return super().select_burst(stream, n)   # steal/system path
 
 
 class SchedPBQ(_LocalQueuesBase):

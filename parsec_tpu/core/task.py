@@ -152,6 +152,10 @@ class TaskClass:
         # (registry weakref, epoch, {mask: device tuple}) — owned by
         # DeviceRegistry.select_best_device; lives/dies with this class
         self._dev_sel_cache = None
+        #: True: Task.__init__ leaves .data as None and prepare_input
+        #: allocates the slots on first need (DTD sets this — its fused
+        #: lane retires most tasks without touching them)
+        self.lazy_data = False
 
     def add_flow(self, flow: Flow) -> Flow:
         flow.flow_index = len(self.flows)
@@ -195,7 +199,10 @@ class Task:
         self.priority = priority
         self.chore_mask = DEV_ALL
         self.status = TASK_STATUS_NONE
-        self.data: List[TaskData] = [TaskData() for _ in range(task_class.nb_flows)]
+        # lazy_data classes defer slot allocation to prepare_input: the DTD
+        # fused lane retires most tasks without ever touching the slots
+        self.data: List[TaskData] = None if task_class.lazy_data else \
+            [TaskData() for _ in range(task_class.nb_flows)]
         self.repo_entry = None
         self.selected_device = None
         self.selected_chore: Optional[Chore] = None

@@ -38,7 +38,8 @@ import numpy as np
 from ..core.context import Context
 from ..core.task import (
     Chore, DEV_ALL, DEV_CPU, DEV_TPU, Flow, FLOW_ACCESS_READ, FLOW_ACCESS_RW,
-    FLOW_ACCESS_WRITE, HOOK_DONE, Task, TaskClass, Taskpool,
+    FLOW_ACCESS_WRITE, HOOK_DONE, TASK_STATUS_COMPLETE, Task, TaskClass,
+    Taskpool,
 )
 from ..data.collection import DataCollection
 from ..data.data import COHERENCY_OWNED, Data, data_from_array
@@ -77,7 +78,7 @@ class DTDTile:
 
     __slots__ = ("data", "key", "dc", "lock", "last_writer", "readers",
                  "rank", "new_tile", "wcount", "writer_rank",
-                 "last_writer_version", "compact_at")
+                 "last_writer_version", "compact_at", "nid")
 
     def __init__(self, data: Data, key: Any, dc: Optional[DataCollection],
                  rank: int = 0, new_tile: bool = False) -> None:
@@ -96,6 +97,10 @@ class DTDTile:
         self.wcount = 0
         self.writer_rank = rank      # rank holding the newest version
         self.last_writer_version = 0
+        #: native-engine tile id (dsl chains in native/src/ptdtd.cpp);
+        #: assigned on first native-mode link. Tiles are POOL-local, so a
+        #: tile's chain lives entirely in one engine mode.
+        self.nid: Optional[int] = None
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"<DTDTile {self.key}>"
@@ -106,18 +111,23 @@ class DTDTask(Task):
 
     __slots__ = ("deps_remaining", "successors", "completed", "lock",
                  "arg_spec", "tiles", "rank", "pending_inputs",
-                 "remote_sends", "ident")
+                 "remote_sends", "ident", "nid")
 
     def __init__(self, taskpool, task_class, priority=0) -> None:
         super().__init__(taskpool, task_class, None, priority)
         self.ident = 0          # insertion index (repr/debug identity)
+        self.nid = -1           # native-engine task id (-1: Python engine)
         # starts at 1: the insertion-in-progress guard (dropped at the end of
         # insert_task, mirroring the count-then-activate protocol of
         # parsec_dtd_schedule_task_if_ready, insert_function.c:2963)
         self.deps_remaining = 1
-        self.successors: List[DTDTask] = []
         self.completed = False
-        self.lock = threading.Lock()
+        # Python-engine pools assign a real lock + successor list at insert
+        # (pred linking / release walk); the native lane never touches
+        # either (GIL-serialized engine), so allocation would be pure
+        # insert-path cost
+        self.successors: Optional[List[DTDTask]] = None
+        self.lock = None
         self.arg_spec: List[Tuple[str, Any]] = []  # ('flow', i) | ('value', v)
         self.tiles: List[Optional[DTDTile]] = []
         self.rank = 0
@@ -200,6 +210,7 @@ class DTDTaskClass(TaskClass):
         super().__init__(name, nb_flows=len(flow_accesses))
         self.fn = fn
         self.count_mode = True
+        self.lazy_data = True     # fused lane retires tasks slot-free
         self.flow_accesses = flow_accesses
         #: False for side-effectful bodies (callbacks, host I/O): run eagerly
         self.jit_ok = jit_ok
@@ -211,6 +222,19 @@ class DTDTaskClass(TaskClass):
 
     def jitted(self):
         return _jitted(self.fn)
+
+    @property
+    def fast_inline(self) -> bool:
+        """True when this class can take the fused inline cycle: exactly
+        one synchronous CPU chore, no evaluate gate — completion is
+        immediate, so insert can run prepare->hook->complete in place."""
+        fi = getattr(self, "_fast_inline", None)
+        if fi is None:
+            fi = self._fast_inline = (
+                len(self.incarnations) == 1
+                and self.incarnations[0].device_type == DEV_CPU
+                and self.incarnations[0].evaluate is None)
+        return fi
 
 
 class DTDTaskpool(Taskpool):
@@ -247,6 +271,19 @@ class DTDTaskpool(Taskpool):
         self._audit = mca.get("dtd_audit", False)
         self._audit_digest = 0      # zlib.crc32 chain: process-independent
         self._audit_count = 0
+        #: native dependency engine (native/src/ptdtd.cpp) — the insert/
+        #: release hot path as a C extension. Decided at first insert:
+        #: single-rank, no comm engine, no audit (those stay on the Python
+        #: engine, which owns the distributed protocol bookkeeping)
+        self._neng = None
+        self._neng_decided = False
+        #: ready-at-insert batch (native lane only): single-stream contexts
+        #: gain nothing from per-task scheduler pushes, so ready tasks
+        #: buffer here and enter the scheduler in BULK at the drain points
+        #: (window stall, wait, close) — one push lock + one priority sort
+        #: per batch instead of per task
+        self._ready_buf: List[DTDTask] = []
+        self._last_class = None   # (fn, accs, nvals, jit, batch, tc)
         if context.comm is not None:
             # distributed: global termination detection + name-keyed registry
             context.comm.fourcounter.monitor_taskpool(self)
@@ -336,6 +373,123 @@ class DTDTaskpool(Taskpool):
         return tc
 
     # ------------------------------------------------------------- insert
+    def _native_engine(self):
+        """The per-context native DTD engine, or None (gated)."""
+        if self._neng_decided:
+            return self._neng
+        self._neng_decided = True
+        ctx = self.ctx
+        # PINS instrumentation (profilers, the DOT grapher) walks Python
+        # successor lists and paired per-task events — pools first touched
+        # under instrumentation stay on the Python engine
+        if ctx.comm is not None or ctx.nb_ranks > 1 or self._audit \
+                or ctx.pins.enabled or not mca.get("native_enabled", True):
+            return None
+        eng = getattr(ctx, "_dtd_neng", None)
+        if eng is None and not getattr(ctx, "_dtd_neng_failed", False):
+            from .. import native as native_mod
+            mod = native_mod.load_ptdtd()
+            if mod is None:
+                ctx._dtd_neng_failed = True
+            else:
+                eng = ctx._dtd_neng = mod.Engine()
+                ctx._dtd_ntasks = {}
+        if eng is not None:
+            # progress loops drain our ready buffer even when the user
+            # drives the context directly (no tp.wait())
+            ctx._drain_hooks.append(self._flush_ready)
+        self._neng = eng
+        return eng
+
+    def _run_lean(self, task: "DTDTask", tc: "DTDTaskClass",
+                  tiles, arg_spec) -> None:
+        """Non-jittable fused body: resolve payloads straight from the
+        tiles, run eagerly, write WRITE flows back — the _cpu_hook eager
+        branch without TaskData slot churn (fused-inline path only)."""
+        pend = task.pending_inputs
+        payloads = []
+        for i, tile in enumerate(tiles):
+            p = pend.pop(i, None) if pend else None
+            if p is None:
+                copy = tile.data.newest_copy()
+                if copy is None:
+                    output.fatal(f"tile {tile!r} has no valid copy "
+                                 f"for {task!r}")
+                p = copy.payload
+            payloads.append(p)
+        vals = [payloads[v] if kind == "flow" else v for kind, v in arg_spec]
+        outs = tc.fn(*vals)
+        if outs is None:
+            outs = ()
+        elif not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        oi = 0
+        for i, acc in enumerate(tc.flow_accesses):
+            if acc & WRITE:
+                new = outs[oi] if oi < len(outs) else payloads[i]
+                oi += 1
+                data = tiles[i].data
+                host = data.get_copy(0)
+                if host is None:
+                    data.create_copy(0, new, COHERENCY_OWNED)
+                else:
+                    host.payload = new
+                data.bump_version(0)
+
+    def _lean_cycle(self, stream, task: "DTDTask") -> None:
+        """The fused select-side task cycle for native-lane eager bodies:
+        run, land outputs, retire, release successors — one call from the
+        progress loop instead of the generic prepare/execute/complete FSM
+        (the machinery a C runtime pays ~0 for; fusing it is how the
+        interpreted runtime stays in the reference's rate class)."""
+        tc = task.task_class
+        self._run_lean(task, tc, task.tiles, task.arg_spec)
+        stream.nb_executed += 1
+        task.status = TASK_STATUS_COMPLETE
+        task.completed = True
+        with self._exec_lock:
+            self._executed += 1
+        ready_ids = self._neng.complete(task.nid)
+        self.ctx._dtd_ntasks.pop(task.nid, None)
+        task.tiles = ()
+        task.arg_spec = ()
+        task.data = ()
+        task.pending_inputs = None
+        if ready_ids:
+            self._schedule_native_ready(ready_ids, stream)
+        self.addto_nb_tasks(-1)
+
+    def _schedule_native_ready(self, ready_ids, stream=None) -> None:
+        """Map newly-ready native task ids to their Python tasks and queue
+        them (shared by the release path and the fused-inline complete)."""
+        ntasks = self.ctx._dtd_ntasks
+        rtasks = []
+        for rid in ready_ids:
+            rt = ntasks[rid]
+            rt.deps_remaining = 0   # paranoid-check coherence
+            rtasks.append(rt)
+        self.ctx.schedule(rtasks, stream)
+
+    def _flush_ready(self) -> None:
+        """Hand the buffered ready-at-insert batch to the scheduler."""
+        if not self._ready_buf:
+            return
+        with self._exec_lock:
+            buf = self._ready_buf
+            self._ready_buf = []
+        if buf:
+            self.ctx.schedule(buf)
+
+    def _window_stall(self) -> None:
+        """Window flow control (ref: insert_function.h:149-157)."""
+        if self.local_inserted - self.executed > self.window_size:
+            self._flush_ready()
+            self.window_stalls += 1
+            target = self.local_inserted - self.threshold_size
+            self.ctx.start()
+            self.ctx._progress_loop(self.ctx.streams[0],
+                                    until=lambda: self.executed >= target)
+
     def insert_task(self, fn: Callable, *args, priority: int = 0,
                     where: int = DEV_ALL, name: Optional[str] = None,
                     jit: bool = True, batch: bool = False) -> Optional[DTDTask]:
@@ -372,11 +526,89 @@ class DTDTaskpool(Taskpool):
                 tiles.append(a)
             else:
                 arg_spec.append(("value", a))
-        tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec), name,
-                            jit_ok=jit, batchable=batch)
+        # one-entry class cache: the dominant pattern is a loop inserting
+        # the same body with the same flow shape (the reference's task
+        # class reuse), so the 5-tuple dict key is usually redundant
+        lc = self._last_class
+        if lc is not None and lc[0] is fn and lc[1] == flow_accesses \
+                and lc[2] == len(arg_spec) and lc[3] == jit and lc[4] == batch:
+            tc = lc[5]
+        else:
+            tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec),
+                                name, jit_ok=jit, batchable=batch)
+            self._last_class = (fn, list(flow_accesses), len(arg_spec),
+                                jit, batch, tc)
         task = DTDTask(self, tc, priority)
         task.arg_spec = arg_spec
         task.tiles = tiles
+        task.ident = self.inserted
+        self.inserted += 1
+
+        neng = self._neng if self._neng_decided else self._native_engine()
+        if neng is not None:
+            # single-rank: owner-computes placement is the identity — the
+            # affinity scan below would always land on my_rank
+            task.rank = self.ctx.my_rank
+            # native fast lane (single-rank): per-tile chain linking, pred
+            # discovery, and the insertion-guard drop happen in ONE
+            # C-extension call; Python keeps the id->task map plus a cheap
+            # chain MIRROR (last_writer/readers/wcount) so tile
+            # introspection keeps its documented meaning
+            nids, naccs = [], []
+            for fi, (tile, acc) in enumerate(zip(tiles, flow_accesses)):
+                if acc & NOTRACK:
+                    copy = tile.data.newest_copy()
+                    if copy is not None:
+                        if task.pending_inputs is None:
+                            task.pending_inputs = {}
+                        task.pending_inputs[fi] = copy.payload
+                    continue
+                nid = tile.nid
+                if nid is None:
+                    nid = tile.nid = neng.tile()
+                nids.append(nid)
+                naccs.append(acc & 0x3)
+                if acc & WRITE:
+                    tile.last_writer = task
+                    tile.readers = []
+                    tile.compact_at = 32
+                    tile.wcount += 1
+                    tile.last_writer_version = tile.wcount
+                else:
+                    readers = tile.readers
+                    if len(readers) >= tile.compact_at:
+                        live = [r for r in readers if not r.completed]
+                        live.append(task)
+                        tile.readers = live
+                        tile.compact_at = max(32, 2 * len(live))
+                    else:
+                        readers.append(task)
+            tid, ndeps = neng.insert(nids, naccs)
+            task.nid = tid
+            task.deps_remaining = ndeps
+            self.ctx._dtd_ntasks[tid] = task
+            self.addto_nb_tasks(1)
+            li = self.local_inserted = self.local_inserted + 1
+            if ndeps == 0:
+                # ready now — but insert_task is ASYNCHRONOUS by contract
+                # (bodies run at the window stall / wait drain, never at
+                # insert): batch toward the scheduler so priorities stay
+                # policy-visible while the push cost amortizes. The GIL
+                # makes the bare append safe against a concurrent flush's
+                # swap-under-lock (the append lands in whichever list the
+                # load observed; a swapped-out list is scheduled AFTER the
+                # append by the same lock)
+                with self._exec_lock:
+                    buf = self._ready_buf
+                    buf.append(task)
+                if len(buf) >= 1024:
+                    self._flush_ready()
+            if li - self._executed > self.window_size:
+                self._window_stall()
+            return task
+
+        task.lock = threading.Lock()      # Python engine: preds/release lock
+        task.successors = []
         # owner-computes rank (ref: rank from affinity tile's rank_of_key);
         # untracked flows don't steer placement
         if affinity_tile is None:
@@ -394,9 +626,8 @@ class DTDTaskpool(Taskpool):
                     affinity_tile = tracked[0]
                 elif tiles:
                     affinity_tile = tiles[0]
-        task.rank = affinity_tile.rank if affinity_tile is not None else self.ctx.my_rank
-        task.ident = self.inserted
-        self.inserted += 1
+        task.rank = affinity_tile.rank if affinity_tile is not None \
+            else self.ctx.my_rank
 
         distributed = self.ctx.comm is not None and self.ctx.nb_ranks > 1
         remote = distributed and task.rank != self.ctx.my_rank
@@ -414,13 +645,7 @@ class DTDTaskpool(Taskpool):
         self.addto_nb_tasks(1)
         self.local_inserted += 1
         self._drop_insertion_guard(task, schedule=True)
-        # window flow control (ref: insert_function.h:149-157)
-        if self.local_inserted - self.executed > self.window_size:
-            self.window_stalls += 1
-            target = self.local_inserted - self.threshold_size
-            self.ctx.start()
-            self.ctx._progress_loop(self.ctx.streams[0],
-                                    until=lambda: self.executed >= target)
+        self._window_stall()
         return task
 
     def _link_tile(self, task: DTDTask, tile: DTDTile, acc: int,
@@ -529,6 +754,10 @@ class DTDTaskpool(Taskpool):
 
     # ------------------------------------------------------------- hooks
     def _prepare_input(self, stream, task: DTDTask) -> int:
+        if task.data is None:     # lazy_data: first touch allocates
+            from ..core.task import TaskData
+            task.data = [TaskData()
+                         for _ in range(task.task_class.nb_flows)]
         pending = task.pending_inputs
         for i, tile in enumerate(task.tiles):
             pend = pending.pop(i, None) if pending else None
@@ -687,6 +916,20 @@ class DTDTaskpool(Taskpool):
     def _release_deps(self, stream, task: DTDTask) -> None:
         """DTD successor release (ref: parsec_dtd_ordering_correctly,
         insert_function_internal.h:277): flip completed, wake successors."""
+        if task.nid >= 0:
+            # native fast lane: the successor walk + newly-ready collection
+            # is one C-extension call (no per-successor locks — the GIL
+            # already serializes engine access)
+            task.completed = True
+            ready_ids = self._neng.complete(task.nid)
+            self.ctx._dtd_ntasks.pop(task.nid, None)
+            task.tiles = ()
+            task.arg_spec = ()
+            task.data = ()
+            task.pending_inputs = None
+            if ready_ids:
+                self._schedule_native_ready(ready_ids, stream)
+            return
         with task.lock:
             task.completed = True
             succs = task.successors
@@ -742,6 +985,7 @@ class DTDTaskpool(Taskpool):
             # sequence surfaces as a fatal here instead of a silent hang
             self.ctx.comm.audit_check(self, self._audit_digest,
                                       self._audit_count)
+        self._flush_ready()
         self.ctx.start()
         target = self.local_inserted
         self.ctx._progress_loop(self.ctx.streams[0],
@@ -756,6 +1000,12 @@ class DTDTaskpool(Taskpool):
             # scheduler-mode inserts execute without an explicit wait();
             # captured ops must not be silently dropped on close
             self._capture.execute()
+        self._flush_ready()
+        if self._neng is not None:
+            try:
+                self.ctx._drain_hooks.remove(self._flush_ready)
+            except ValueError:
+                pass
         if self._open:
             self._open = False
             self.addto_nb_pending_actions(-1)

@@ -40,8 +40,13 @@ from ...core.futures import DataCopyFuture
 from ...data.data import COHERENCY_OWNED, DataCopy
 from ...data.reshape import NamedDatatype, default_datatype
 from ...device.tpu import make_tpu_hook
-from ...utils import output
+from ...utils import mca, output
 from . import parser as P
+
+mca.register("ptg_agglomerate", True,
+             "Execute statically-independent flowless PTG classes "
+             "as one fused sweep at startup (no per-task "
+             "scheduling cycle)", type=bool)
 
 _ACCESS_MAP = {
     P.FLOW_READ: FLOW_ACCESS_READ,
@@ -486,6 +491,10 @@ class PTGTaskpool(Taskpool):
             # tuples, independent of any user make_key_fn hash key
             canonical_key = tc._ptg_canonical_key(task)
             for fi, flow in enumerate(tc.flows):
+                if flow.access & FLOW_ACCESS_CTL:
+                    # control deps carry no data: their only job (the
+                    # dependency count) was done at the producer's release
+                    continue
                 alts = tc._ptg_in_specs[fi]
                 ep = tc._ptg_active_in(alts, env)
                 if ep is None:
@@ -588,6 +597,7 @@ class PTGTaskpool(Taskpool):
             # through the body, so the jit wrapper is pure dispatch
             # overhead — run the raw python body
             raw = getattr(fn, "__wrapped__", fn)
+            tc._ptg_raw_body = raw      # the agglomerated-sweep entry
 
             def flowless_hook(stream, task: Task) -> int:
                 raw(*[task.locals[p] for p in tc._ptg_spec.params])
@@ -753,19 +763,90 @@ class PTGTaskpool(Taskpool):
             loc.pop(param, None)
         yield from rec(0, {})
 
+    def _agglomerable(self, tc: TaskClass) -> bool:
+        """A class the runtime may execute as ONE fused sweep at startup:
+        statically proven independent — no flows at all (so no deps in or
+        out, no data, nothing downstream waits on any instance) and no
+        custom startup seeding. The PTG analogue of capture: when the
+        static structure proves there is nothing to schedule AROUND, the
+        per-task scheduling cycle is pure overhead (the reference pays ~0
+        for that cycle in C; we eliminate it instead)."""
+        return (not tc.flows
+                and getattr(tc, "_ptg_startup_fn", None) is None
+                # exactly one ungated body: multi-incarnation classes pick
+                # a chore per task ([evaluate] gates, device choice) — the
+                # sweep must not bypass that selection
+                and len(tc.incarnations) == 1
+                and tc.incarnations[0].evaluate is None
+                # a sweep runs on the startup thread: with worker streams
+                # the per-task path spreads instances across cores instead
+                and len(self.ctx.streams) == 1
+                and mca.get("ptg_agglomerate", True)
+                and not self.ctx.pins.enabled
+                and not self.ctx.paranoid)
+
+    def _enum_class_fast(self, tc: TaskClass):
+        """Param-value tuples via itertools.product when every range bound
+        is static (depends on globals only); None when bounds reference
+        other params (triangular spaces fall back to the dict walk)."""
+        import itertools
+        env0 = self._env({})
+        rs = []
+        for i, (param, lo, hi, step) in enumerate(tc._ptg_ranges):
+            if param != tc._ptg_spec.params[i]:
+                return None
+            try:
+                lo_v, hi_v, st_v = int(lo(env0)), int(hi(env0)), int(step(env0))
+            except Exception:  # noqa: BLE001 — bound needs an outer param
+                return None
+            rs.append(range(lo_v, hi_v + 1 if st_v > 0 else hi_v - 1, st_v))
+        return itertools.product(*rs) if rs else iter(((),))
+
+    def _run_agglomerated(self, stream, tc: TaskClass) -> int:
+        """Execute a proven-independent flowless class as one fused sweep;
+        returns the instance count (reported executed, never scheduled)."""
+        raw = tc._ptg_raw_body
+        my_rank = self.ctx.my_rank
+        distributed = self.ctx.nb_ranks > 1 and self.ctx.comm is not None
+        n = 0
+        it = None if distributed else self._enum_class_fast(tc)
+        if it is not None:
+            for vals in it:
+                raw(*vals)
+                n += 1
+        else:
+            params = tc._ptg_spec.params
+            for loc in self._enum_class(tc):
+                if distributed and tc._ptg_rank_of(loc) != my_rank:
+                    continue
+                raw(*[loc[p] for p in params])
+                n += 1
+        stream.nb_executed += n
+        return n
+
     def _startup(self, stream, tp) -> List[Task]:
         total = 0
         ready: List[Task] = []
         my_rank = self.ctx.my_rank
         distributed = self.ctx.nb_ranks > 1 and self.ctx.comm is not None
-        for tc, loc in self._enumerate():
-            if distributed and tc._ptg_rank_of(loc) != my_rank:
-                continue
-            total += 1
-            if getattr(tc, "_ptg_startup_fn", None) is not None:
-                continue    # custom startup seeds this class below
-            if tc.dependencies_goal_fn(loc) == 0:
-                ready.append(self.ctx.make_task(self, tc, loc))
+        agg = {tcs.name for tcs in self.program.spec.task_classes
+               if self._agglomerable(self._classes[tcs.name])}
+        self._agglomerated = 0
+        for name in agg:
+            self._agglomerated += self._run_agglomerated(
+                stream, self._classes[name])
+        for tcs in self.program.spec.task_classes:
+            if tcs.name in agg:
+                continue        # executed above, never scheduled/counted
+            tc = self._classes[tcs.name]
+            for loc in self._enum_class(tc):
+                if distributed and tc._ptg_rank_of(loc) != my_rank:
+                    continue
+                total += 1
+                if getattr(tc, "_ptg_startup_fn", None) is not None:
+                    continue    # custom startup seeds this class below
+                if tc.dependencies_goal_fn(loc) == 0:
+                    ready.append(self.ctx.make_task(self, tc, loc))
         # user-defined startup (ref: udf.jdf startup_fn): fn(taskpool,
         # task_class) yields the locals of this class's initial ready tasks
         for tcs in self.program.spec.task_classes:

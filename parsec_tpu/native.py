@@ -43,8 +43,10 @@ _KEY_MAX = 16
 
 def _build() -> bool:
     try:
-        r = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
-                           text=True, timeout=120)
+        import sys
+        r = subprocess.run(["make", "-C", _NATIVE_DIR,
+                            f"PYTHON={sys.executable}"],
+                           capture_output=True, text=True, timeout=120)
         if r.returncode != 0:
             output.debug_verbose(1, "native", f"build failed: {r.stderr[-500:]}")
             return False
@@ -100,6 +102,44 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+_ptdtd_mod = [None, False]   # [module, attempted]
+
+
+def load_ptdtd():
+    """The CPython-extension DTD engine (native/src/ptdtd.cpp), or None.
+
+    A separate artifact from libptcore.so: per-task hot paths need
+    C-extension call costs (~0.2us) — the ctypes boundary (~2us) that the
+    coarse bindings above tolerate would eat the entire win (module
+    docstring)."""
+    if _ptdtd_mod[1]:
+        return _ptdtd_mod[0]
+    with _lib_lock:
+        if _ptdtd_mod[1]:
+            return _ptdtd_mod[0]
+        _ptdtd_mod[1] = True
+        if not mca.get("native_enabled", True):
+            return None
+        import importlib.util
+        import sysconfig
+        # exact ABI-tagged filename of the RUNNING interpreter — a wildcard
+        # could load a stale extension built against another Python
+        so = os.path.join(_NATIVE_DIR, "build",
+                          "_ptdtd" + sysconfig.get_config_var("EXT_SUFFIX"))
+        if not os.path.exists(so) and not (_build() and os.path.exists(so)):
+            return None
+        try:
+            spec = importlib.util.spec_from_file_location("parsec_tpu._ptdtd",
+                                                          so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _ptdtd_mod[0] = mod
+            output.debug_verbose(1, "native", f"_ptdtd loaded from {so}")
+        except Exception as e:  # noqa: BLE001
+            output.debug_verbose(1, "native", f"_ptdtd load failed: {e}")
+        return _ptdtd_mod[0]
 
 
 class NativeDepTable:

@@ -1,0 +1,203 @@
+"""Native DTD dependency engine (native/src/ptdtd.cpp) + the fast-lane
+runtime paths around it: the C-extension chain semantics must match the
+Python engine exactly, and the burst/buffer machinery must not lose tasks.
+"""
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.dsl.dtd import DTDTaskpool, NOTRACK, READ, RW, WRITE
+from parsec_tpu import native as native_mod
+
+
+@pytest.fixture()
+def ctx():
+    c = pt.Context(nb_cores=1)
+    yield c
+    c.fini()
+
+
+def _engine():
+    mod = native_mod.load_ptdtd()
+    if mod is None:
+        pytest.skip("native _ptdtd unavailable")
+    return mod.Engine()
+
+
+# ---------------------------------------------------------------- C engine
+
+def test_engine_raw_chain_semantics():
+    """w0 -> {r1, r2} -> w3: RAW, WAR, and retire-once, straight on the
+    C extension."""
+    e = _engine()
+    t = e.tile()
+    tid, nd = e.insert((t,), (WRITE,))
+    assert nd == 0
+    r1, nd1 = e.insert((t,), (READ,))
+    r2, nd2 = e.insert((t,), (READ,))
+    assert nd1 == nd2 == 1                   # RAW on w0
+    w3, nd3 = e.insert((t,), (RW,))
+    assert nd3 == 3                          # WAR on r1,r2 + WAW on w0
+    assert e.complete(tid) == (r1, r2)
+    assert e.complete(r1) == ()
+    assert e.complete(r2) == (w3,)
+    assert e.complete(w3) == ()
+    assert e.pending() == 0
+
+
+def test_engine_write_resets_readers():
+    e = _engine()
+    t = e.tile()
+    w0, _ = e.insert((t,), (WRITE,))
+    r, _ = e.insert((t,), (READ,))
+    w1, ndw = e.insert((t,), (WRITE,))       # WAR on r, WAW on w0
+    assert ndw == 2
+    r2, ndr = e.insert((t,), (READ,))        # RAW on w1 ONLY (readers reset)
+    assert ndr == 1
+    e.complete(w0)
+    e.complete(r)
+    assert e.complete(w1) == (r2,)
+
+
+def test_engine_dedup_multi_flow():
+    """A task reading the same writer through TWO tiles counts ONE dep
+    (pred dedup via visit stamps)."""
+    e = _engine()
+    ta, tb = e.tile(), e.tile()
+    w, _ = e.insert((ta, tb), (WRITE, WRITE))
+    r, nd = e.insert((ta, tb), (READ, READ))
+    assert nd == 1
+    assert e.complete(w) == (r,)
+
+
+def test_engine_completed_twice_raises():
+    e = _engine()
+    t = e.tile()
+    tid, _ = e.insert((t,), (WRITE,))
+    e.complete(tid)
+    with pytest.raises(RuntimeError):
+        e.complete(tid)
+
+
+def test_engine_reader_compaction():
+    """Hundreds of retired readers between writes must not leak into the
+    WAR count of the next write."""
+    e = _engine()
+    t = e.tile()
+    w0, _ = e.insert((t,), (WRITE,))
+    e.complete(w0)
+    for _ in range(300):
+        rid, nd = e.insert((t,), (READ,))
+        assert nd == 0                       # writer completed
+        e.complete(rid)
+    w1, nd = e.insert((t,), (WRITE,))
+    assert nd == 0                           # every reader already retired
+    tasks_ever, tiles_ever = e.sizes()
+    assert tasks_ever == 302 and tiles_ever == 1
+
+
+# ------------------------------------------------------------- runtime lane
+
+def test_native_lane_chain_correctness(ctx):
+    tp = DTDTaskpool(ctx, "nl")
+    assert tp._native_engine() is not None, "native lane should engage"
+    t = tp.tile_new((4, 4), np.float32)
+    t.data.create_copy(0, np.zeros((4, 4), np.float32))
+    for _ in range(200):
+        tp.insert_task(lambda a: a + 1.0, (t, RW), jit=False)
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(
+        np.asarray(t.data.newest_copy().payload), 200.0)
+
+
+def test_native_lane_mixed_dag(ctx):
+    """Diamond: w -> {r, r} -> w with real value checks through the lane."""
+    tp = DTDTaskpool(ctx, "nd")
+    a = tp.tile_new((2, 2), np.float32)
+    b = tp.tile_new((2, 2), np.float32)
+    a.data.create_copy(0, np.ones((2, 2), np.float32))
+    b.data.create_copy(0, np.zeros((2, 2), np.float32))
+    tp.insert_task(lambda x: x * 3.0, (a, RW), jit=False)          # a=3
+    tp.insert_task(lambda x, y: y + x, (a, READ), (b, RW), jit=False)  # b=3
+    tp.insert_task(lambda x, y: y + x, (a, READ), (b, RW), jit=False)  # b=6
+    tp.insert_task(lambda x: x * 10.0, (a, RW), jit=False)         # a=30
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(a.data.newest_copy().payload), 30.0)
+    np.testing.assert_allclose(np.asarray(b.data.newest_copy().payload), 6.0)
+
+
+def test_native_lane_tile_mirror_introspection(ctx):
+    """The Python-side chain mirror keeps last_writer/readers meaningful."""
+    tp = DTDTaskpool(ctx, "nm")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    w = tp.insert_task(lambda a: a + 1.0, (t, RW), jit=False, name="W")
+    r = tp.insert_task(lambda a: None, (t, READ), jit=False, name="R")
+    u = tp.insert_task(lambda a: None, (t, READ | NOTRACK), jit=False,
+                       name="U")
+    assert t.last_writer is w
+    assert u not in t.readers
+    assert u.deps_remaining == 0
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=30)
+
+
+def test_native_lane_off_when_distributed():
+    """Comm-attached contexts stay on the Python engine (the protocol
+    bookkeeping lives there)."""
+    from parsec_tpu.comm.threads import run_distributed
+
+    def program(rank, fabric):
+        from parsec_tpu.comm.remote_dep import RemoteDepEngine
+        from parsec_tpu.comm.threads import ThreadsCE
+        ctx = pt.Context(nb_cores=1, my_rank=rank, nb_ranks=2)
+        RemoteDepEngine(ctx, ThreadsCE(fabric, rank))
+        tp = DTDTaskpool(ctx, "off")
+        used = tp._native_engine()
+        tp.close()
+        ctx.wait(timeout=30)
+        ctx.fini()
+        return used is None
+
+    assert all(run_distributed(2, program, timeout=60))
+
+
+def test_native_lane_error_surfaces_at_wait(ctx):
+    tp = DTDTaskpool(ctx, "ne")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+
+    def bad(x):
+        raise ValueError("intentional")
+
+    tp.insert_task(bad, (t, RW), jit=False)
+    with pytest.raises((ValueError, RuntimeError)):
+        tp.wait(timeout=10)
+        tp.close()
+        ctx.wait(timeout=10)
+    ctx.fini()
+
+
+def test_ready_buffer_visible_to_direct_progress_loop(ctx):
+    """Drain hooks: a user driving ctx._progress_loop directly (no
+    tp.wait()) still sees buffered ready tasks (regression: the device
+    batching test pattern)."""
+    tp = DTDTaskpool(ctx, "nb")
+    hits = []
+    tiles = [tp.tile_new((2, 2)) for _ in range(4)]
+    for t in tiles:
+        t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    for i, t in enumerate(tiles):
+        tp.insert_task(lambda a, i=i: hits.append(i), (t, READ), jit=False)
+    ctx._progress_loop(ctx.streams[0], until=lambda: len(hits) == 4,
+                       timeout=10)
+    assert sorted(hits) == [0, 1, 2, 3]
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=10)
