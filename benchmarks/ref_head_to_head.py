@@ -34,21 +34,10 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def cgroup_quota():
     """(quota_cores, nproc) — the honest EP-scaling context (VERDICT r4
-    weak #3)."""
-    quota = None
-    try:
-        raw = open("/sys/fs/cgroup/cpu.max").read().split()
-        if raw[0] != "max":
-            quota = float(raw[0]) / float(raw[1])
-    except OSError:
-        try:
-            q = int(open("/sys/fs/cgroup/cpu/cpu.cfs_quota_us").read())
-            p = int(open("/sys/fs/cgroup/cpu/cpu.cfs_period_us").read())
-            if q > 0:
-                quota = q / p
-        except OSError:
-            pass
-    return quota, os.cpu_count()
+    weak #3). One implementation: parsec_tpu.launch.cpu_budget."""
+    from parsec_tpu.launch import cpu_budget
+    b = cpu_budget()
+    return b["cgroup_cpu_quota_cores"], b["nproc"]
 
 
 def run_ref_schedmicro(levels=8, nt=4096, tries=5):

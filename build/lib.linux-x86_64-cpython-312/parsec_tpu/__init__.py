@@ -1,0 +1,87 @@
+"""parsec_tpu — a TPU-native task-based dataflow runtime.
+
+A brand-new framework with the capabilities of PaRSEC (ICLDisco/parsec):
+applications are DAGs of micro-tasks with dataflow dependencies, described
+either through a compiled Parameterized Task Graph DSL or a dynamic
+insert-task interface, executed by a distributed runtime that overlaps
+computation with communication and manages versioned data copies across
+memory spaces. Task bodies on the compute path are pre-compiled XLA/Pallas
+executables dispatched asynchronously through JAX; distribution is expressed
+over TPU meshes with XLA collectives on ICI/DCN.
+
+Layer map (mirrors SURVEY.md §1):
+  utils/   — config (MCA params), logging, tracing        (ref L0)
+  core/    — task model, scheduling, termdet, PINS        (ref L2)
+  data/    — data copies/coherency, collections, arenas   (ref L1/L6)
+  comm/    — comm engine + remote dependency protocol     (ref L3)
+  device/  — device modules incl. the TPU module          (ref L4)
+  dsl/     — PTG compiler + DTD insert_task               (ref L5)
+  ops/     — Pallas/XLA tile kernels (gemm, potrf, ...)
+  parallel/— mesh/SPMD execution paths (shard_map)
+  tools/   — trace readers/converters                     (ref L7)
+"""
+
+__version__ = "0.4.0"
+
+from .core.context import Context, init, fini
+from .core.task import (
+    Task, TaskClass, Taskpool, Flow, Dep, Chore,
+    HOOK_DONE, HOOK_AGAIN, HOOK_ASYNC, HOOK_NEXT, HOOK_DISABLE, HOOK_ERROR,
+    FLOW_ACCESS_READ, FLOW_ACCESS_WRITE, FLOW_ACCESS_RW, FLOW_ACCESS_CTL,
+    DEV_CPU, DEV_TPU, DEV_ALL,
+)
+from .utils import mca
+
+__all__ = [
+    "Context", "init", "fini", "Task", "TaskClass", "Taskpool", "Flow", "Dep",
+    "Chore", "mca",
+    "HOOK_DONE", "HOOK_AGAIN", "HOOK_ASYNC", "HOOK_NEXT", "HOOK_DISABLE",
+    "HOOK_ERROR",
+    "FLOW_ACCESS_READ", "FLOW_ACCESS_WRITE", "FLOW_ACCESS_RW",
+    "FLOW_ACCESS_CTL", "DEV_CPU", "DEV_TPU", "DEV_ALL",
+    # lazy (PEP 562) exports below
+    "DTDTaskpool", "READ", "WRITE", "RW", "AFFINITY", "compile_ptg",
+    "TiledMatrix", "TwoDimBlockCyclic", "NamedDatatype",
+    "RemoteDepEngine", "ThreadsCE", "TCPCE", "run_distributed",
+    "run_distributed_procs", "init_from_env", "checkpoint",
+]
+
+# the rest of the user surface resolves lazily so `import parsec_tpu`
+# stays light (DSLs, collections, comm backends pull in their own deps)
+_LAZY = {
+    "DTDTaskpool": ("parsec_tpu.dsl.dtd", "DTDTaskpool"),
+    "READ": ("parsec_tpu.dsl.dtd", "READ"),
+    "WRITE": ("parsec_tpu.dsl.dtd", "WRITE"),
+    "RW": ("parsec_tpu.dsl.dtd", "RW"),
+    "AFFINITY": ("parsec_tpu.dsl.dtd", "AFFINITY"),
+    "compile_ptg": ("parsec_tpu.dsl.ptg.compiler", "compile_ptg"),
+    "TiledMatrix": ("parsec_tpu.data.matrix", "TiledMatrix"),
+    "TwoDimBlockCyclic": ("parsec_tpu.data.matrix", "TwoDimBlockCyclic"),
+    "SymTwoDimBlockCyclic": ("parsec_tpu.data.matrix", "SymTwoDimBlockCyclic"),
+    "SymTwoDimBlockCyclicBand": ("parsec_tpu.data.matrix", "SymTwoDimBlockCyclicBand"),
+    "SBCDistribution": ("parsec_tpu.data.matrix", "SBCDistribution"),
+    "VectorTwoDimCyclic": ("parsec_tpu.data.matrix", "VectorTwoDimCyclic"),
+    "NamedDatatype": ("parsec_tpu.data.reshape", "NamedDatatype"),
+    "RemoteDepEngine": ("parsec_tpu.comm.remote_dep", "RemoteDepEngine"),
+    "ThreadsCE": ("parsec_tpu.comm.threads", "ThreadsCE"),
+    "TCPCE": ("parsec_tpu.comm.tcp", "TCPCE"),
+    "run_distributed": ("parsec_tpu.comm.threads", "run_distributed"),
+    "run_distributed_procs": ("parsec_tpu.comm.tcp", "run_distributed_procs"),
+    "init_from_env": ("parsec_tpu.comm.tcp", "init_from_env"),
+    "checkpoint": ("parsec_tpu.utils.checkpoint", None),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(entry[0])
+    value = mod if entry[1] is None else getattr(mod, entry[1])
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

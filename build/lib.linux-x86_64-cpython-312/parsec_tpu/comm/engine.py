@@ -1,0 +1,148 @@
+"""Communication-engine abstraction (CE).
+
+Re-design of parsec/parsec_comm_engine.h:14-176: a backend-neutral vtable —
+active-message tags with fixed max sizes (``tag_register``), memory
+registration, one-sided ``put/get`` with remote completion AMs, ``send_am``,
+``progress``, ``pack/unpack``, ``sync`` and capability flags. The reference
+ships one production backend (single-threaded "funnelled" MPI,
+parsec_mpi_funnelled.c); here the backends are:
+
+* :class:`parsec_tpu.comm.threads.ThreadsCE` — N in-process ranks joined by
+  queues; the test fabric (stands where oversubscribed localhost MPI stood in
+  the reference's test strategy, tests/CMakeLists.txt:1032-1042).
+* the SPMD/ICI path (:mod:`parsec_tpu.parallel.spmd`) — bulk tile movement as
+  XLA collectives; control messages stay host-side.
+
+Tag space mirrors the reference (parsec_comm_engine.h:29-40): internal
+GET/PUT handshake tags, remote-dep activate, termdet, DSL-reserved tags,
+``MAX_REGISTERED_TAGS = 12``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+MAX_REGISTERED_TAGS = 12  # ref: PARSEC_MAX_REGISTERED_TAGS
+
+# predefined tags (ref: parsec_comm_engine.h:29-40 enumeration)
+TAG_INTERNAL_GET = 0
+TAG_INTERNAL_PUT = 1
+TAG_REMOTE_DEP_ACTIVATE = 2
+TAG_TERMDET = 3
+TAG_DSL_BASE = 4          # TTG-style DSL reservations start here
+TAG_CNT_AGG = 10          # cross-rank counter aggregation at fini
+TAG_DTD_AUDIT = 11        # DTD replay-consistency auditor exchange
+
+# capability flags (ref: parsec_comm_engine capabilities)
+CAP_ONESIDED = 0x1
+CAP_MULTITHREADED = 0x2
+CAP_ACCELERATOR_MEM = 0x4   # can move device-resident buffers directly
+CAP_STREAMING = 0x8         # AM payloads ride the same ordered stream as
+                            # headers: rendezvous buys no registration or
+                            # one-sidedness, so eager (PUT-with-activate)
+                            # is the right default at ANY size
+
+
+@dataclass
+class AMRegistration:
+    tag: int
+    msg_size: int
+    callback: Callable[["CommEngine", int, bytes, Any], None]  # (ce, src, hdr, payload)
+
+
+class CommEngine:
+    """The CE vtable (ref: parsec_comm_engine.h:43-176)."""
+
+    capabilities = 0
+
+    def __init__(self, my_rank: int = 0, nb_ranks: int = 1) -> None:
+        self.my_rank = my_rank
+        self.nb_ranks = nb_ranks
+        self._tags: Dict[int, AMRegistration] = {}
+        self._lock = threading.Lock()
+        self._handles: Dict[int, Any] = {}
+        self._next_handle = 0
+
+    # --- active messages ----------------------------------------------------
+    def tag_register(self, tag: int, callback, msg_size: int = 4096) -> None:
+        if len(self._tags) >= MAX_REGISTERED_TAGS:
+            raise RuntimeError("out of AM tags (MAX_REGISTERED_TAGS)")
+        self._tags[tag] = AMRegistration(tag, msg_size, callback)
+
+    def tag_unregister(self, tag: int) -> None:
+        self._tags.pop(tag, None)
+
+    def send_am(self, tag: int, dst: int, header: Any,
+                payload: Any = None) -> None:
+        raise NotImplementedError
+
+    # --- one-sided (emulated over two-sided AMs with internal handshake
+    # tags, exactly like the reference emulates RDMA over MPI;
+    # parsec_mpi_funnelled.c) — shared by every two-sided backend ----------
+    def mem_register(self, buf) -> Any:
+        with self._lock:
+            h = self._next_handle
+            self._next_handle += 1
+            self._handles[h] = buf
+        return h
+
+    def mem_unregister(self, handle) -> None:
+        with self._lock:
+            self._handles.pop(handle, None)
+
+    def resolve(self, handle):
+        return self._handles.get(handle)
+
+    def put(self, dst: int, local_buf, remote_handle, on_complete=None) -> None:
+        self.send_am(TAG_INTERNAL_PUT, dst, {"handle": remote_handle}, local_buf)
+        if on_complete is not None:
+            on_complete()
+
+    def get(self, src: int, remote_handle, on_complete=None) -> None:
+        """Request the remote buffer; data arrives as the matching PUT.
+
+        Unlike :meth:`put`, ``on_complete`` CANNOT fire here — the GET is
+        only a request, and completion is observable solely through the
+        PUT delivery on the registered tag.  Passing a callback is a
+        caller bug (it would wait forever), so it is rejected loudly.
+        """
+        if on_complete is not None:
+            raise ValueError(
+                "CommEngine.get() cannot invoke on_complete: completion "
+                "arrives as the matching PUT on the registered tag — hook "
+                "the PUT delivery instead")
+        self.send_am(TAG_INTERNAL_GET, src,
+                     {"handle": remote_handle, "requester": self.my_rank}, None)
+
+    # --- progress / sync ----------------------------------------------------
+    def progress(self) -> int:
+        """Drain incoming messages; returns #messages handled."""
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        """Collective barrier over all ranks."""
+        raise NotImplementedError
+
+    def enable(self) -> None:
+        pass
+
+    def fini(self) -> None:
+        pass
+
+    # --- pack/unpack --------------------------------------------------------
+    def pack(self, obj: Any) -> bytes:
+        import pickle
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def unpack(self, data: bytes) -> Any:
+        import pickle
+        return pickle.loads(data)
+
+    def _deliver(self, tag: int, src: int, header: Any, payload: Any) -> bool:
+        reg = self._tags.get(tag)
+        if reg is None:
+            return False
+        reg.callback(self, src, header, payload)
+        return True

@@ -1,0 +1,174 @@
+"""Cross-host device-native payload plane: PJRT transfer server pulls.
+
+The missing half of the device-memory comms story (comm/ici.py covers
+devices addressable by ONE process): when a device-resident payload must
+cross OS ranks — the production one-process-per-host shape — the reference
+moves GPU buffers directly through the funnelled CE when allowed
+(``parsec_mpi_allow_gpu_memory_communications``,
+parsec/parsec_internal.h:504, send path parsec_mpi_funnelled.c:642). The
+TPU-native equivalent is PJRT's transfer server
+(``jax.experimental.transfer``): the owner registers the array for pull and
+ships a tiny :class:`XHostRef` descriptor over the host fabric; the
+consumer's PJRT client pulls the buffer over the transfer transport
+(DMA-class on real fleets, TCP bulk sockets here) directly into its own
+device memory — the payload never enters the host AM frame.
+
+Flow control mirrors an RDMA rendezvous: ``offer()`` pins the array in a
+ledger until the consumer's transport-level ACK retires it (TCPCE sends
+``_KIND_XACK`` after a successful pull), so the buffer outlives the
+in-flight pull without an unbounded leak.
+
+Gating: ``--mca comm_device_mem 1`` (default off, like the reference's
+GPU-comms flag). The host-bounce fallback — device arrays materialized
+into wire bytes — stays COUNTED via ``comm.host_materialized_msgs``;
+successful pulls count ``comm.xhost_d2d_msgs/bytes`` on the consumer and
+``comm.xhost_offered_msgs`` on the producer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from ..utils import mca, output
+from ..utils.counters import counters
+
+mca.register("comm_device_mem", False,
+             "Move device-resident payloads across OS ranks via the PJRT "
+             "transfer server instead of host-materializing them into the "
+             "wire frame (ref: parsec_mpi_allow_gpu_memory_communications)",
+             type=bool)
+
+CTR_OFFERED = "comm.xhost_offered_msgs"
+CTR_D2D_MSGS = "comm.xhost_d2d_msgs"
+CTR_D2D_BYTES = "comm.xhost_d2d_bytes"
+
+
+@dataclass(frozen=True)
+class XHostRef:
+    """Picklable pull descriptor that rides the host AM frame in place of
+    the array payload (the rendezvous envelope)."""
+    uuid: int
+    address: str
+    shape: Tuple[int, ...]
+    dtype: str          # dtype NAME ("bfloat16", "float32"): .str would
+                        # collapse extended dtypes to raw void ("<V2")
+
+
+def local_device():
+    """The jax device this OS rank is bound to (the launcher binding rule,
+    PARSEC_TPU_LOCAL_DEVICE — same rule the TPU module uses)."""
+    import jax
+    devs = jax.devices()
+    bind = os.environ.get("PARSEC_TPU_LOCAL_DEVICE")
+    return devs[int(bind) % len(devs)] if bind is not None else devs[0]
+
+
+class XHostTransfer:
+    """One per OS rank: a PJRT transfer server (lazy) + connection cache.
+
+    ``offer(payload) -> XHostRef`` registers a device array for pull and
+    pins it; ``pull(ref) -> jax.Array`` fetches a peer's offer onto this
+    rank's device; ``retire(uuid)`` drops the pin once the peer ACKs.
+    """
+
+    @staticmethod
+    def available() -> bool:
+        try:
+            import jax.experimental.transfer  # noqa: F401
+            return True
+        except Exception:
+            return False
+
+    def __init__(self, bind_host: str = "127.0.0.1") -> None:
+        self._bind = bind_host
+        self._srv = None
+        self._conns: Dict[str, Any] = {}
+        self._ledger: Dict[int, Any] = {}      # uuid -> pinned array
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._rank_salt = (os.getpid() & 0xFFFFF) << 40
+
+    def _server(self):
+        # double-checked under the lock: two concurrent first offers must
+        # not each start a server (the loser's address would be stamped
+        # into an already-shipped ref and then garbage-collected)
+        if self._srv is None:
+            with self._lock:
+                if self._srv is None:
+                    import jax.experimental.transfer as jt
+                    dev = local_device()
+                    # bulk data rides explicit socket transports: the
+                    # default process-local transport cannot serve a
+                    # remote puller
+                    self._srv = jt.start_transfer_server(
+                        dev.client, f"{self._bind}:0", [f"{self._bind}:0"])
+                    output.debug_verbose(
+                        1, "xhost",
+                        f"transfer server at {self._srv.address()}")
+        return self._srv
+
+    @property
+    def address(self) -> str:
+        return self._server().address()
+
+    # ------------------------------------------------------------- producer
+    def offer(self, payload, dst: Optional[int] = None) -> XHostRef:
+        import numpy as np
+        srv = self._server()
+        with self._lock:
+            self._seq += 1
+            uuid = self._rank_salt | self._seq
+            self._ledger[uuid] = (payload, dst)   # pinned until ACK
+        srv.await_pull(uuid, [payload])
+        counters.add(CTR_OFFERED)
+        return XHostRef(uuid, srv.address(), tuple(payload.shape),
+                        str(np.dtype(payload.dtype)))
+
+    def retire(self, uuid: int) -> None:
+        with self._lock:
+            self._ledger.pop(uuid, None)
+
+    def retire_peer(self, dst: int) -> None:
+        """Drop every pin offered to a rank that died or departed — its
+        pulls will never come, and the pinned device buffers must not
+        outlive the failure (the 'unbounded leak' guard). The PJRT
+        server's own await_pull registration has no cancel API; dropping
+        the framework pin releases OUR strong reference, which is the one
+        that scales with traffic."""
+        with self._lock:
+            for uuid in [u for u, (_, d) in self._ledger.items()
+                         if d == dst]:
+                self._ledger.pop(uuid)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ledger.clear()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._ledger)
+
+    # ------------------------------------------------------------- consumer
+    def pull(self, ref: XHostRef):
+        import jax
+        import numpy as np
+        from jax.sharding import SingleDeviceSharding
+        with self._lock:
+            conn = self._conns.get(ref.address)
+        if conn is None:
+            fresh = self._server().connect(ref.address)
+            with self._lock:
+                # two threads can race to connect; keep exactly one cached
+                # connection per address (the loser's would otherwise leak —
+                # transfer connections are never closed)
+                conn = self._conns.setdefault(ref.address, fresh)
+        sds = jax.ShapeDtypeStruct(
+            ref.shape, np.dtype(ref.dtype),
+            sharding=SingleDeviceSharding(local_device()))
+        (arr,) = conn.pull(ref.uuid, [sds])
+        counters.add(CTR_D2D_MSGS)
+        counters.add(CTR_D2D_BYTES, int(arr.nbytes))
+        return arr

@@ -1,0 +1,744 @@
+"""Runtime context, execution streams, and the scheduling state machine.
+
+Re-design of parsec/parsec.c (parsec_init, :405) + parsec/scheduling.c:
+
+* :class:`ExecutionStream` — one per worker thread (ref:
+  parsec_execution_stream_t, parsec/include/parsec/execution_stream.h:36-76).
+* :class:`Context` — process-wide state (ref: parsec_context_t,
+  execution_stream.h:117-174), with ``add_taskpool / start / wait / test``
+  mirroring parsec/runtime.h:174-388.
+* The per-thread hot loop re-creates ``__parsec_context_wait``
+  (scheduling.c:727, hot loop :789-818) including exponential backoff and
+  master-thread communication progress.
+* ``_task_progress`` re-creates ``__parsec_task_progress`` (scheduling.c:507)
+  and ``__parsec_execute`` (scheduling.c:126): prepare_input → best-device
+  selection → chore evaluate/hook → return-code dispatch
+  (DONE/AGAIN/ASYNC/NEXT/DISABLE, scheduling.c:518-566).
+* ``generic_release_deps`` re-creates the dependency-release engine
+  (parsec_release_dep_fct parsec.c:1837, parsec_release_local_OUT_dependencies
+  parsec.c:1750, parsec_update_deps_with_mask parsec.c:1657).
+
+TPU-first deviation: device chores dispatch pre-compiled XLA/Pallas
+executables asynchronously and return ``HOOK_ASYNC``; the progress loop polls
+device modules (the analogue of the reference's GPU manager thread,
+device_gpu.c:3376+) so a single host thread can keep the chip saturated —
+important because host cores are scarce relative to TPU throughput.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..utils import mca, output
+from . import pins as pins_mod
+from . import scheduler as sched_mod
+from . import termdet as termdet_mod
+from .datarepo import DataRepo
+from .task import (
+    DEV_ALL, DEV_CPU, FLOW_ACCESS_CTL, FLOW_ACCESS_WRITE,
+    HOOK_AGAIN, HOOK_ASYNC, HOOK_DISABLE, HOOK_DONE, HOOK_ERROR, HOOK_NEXT,
+    Task, TaskClass, Taskpool,
+    TASK_STATUS_COMPLETE, TASK_STATUS_HOOK, TASK_STATUS_PREPARE_INPUT,
+)
+
+mca.register("runtime_nb_cores", 0, "Worker threads (0 = autodetect)", type=int)
+mca.register("runtime_backoff_max_us", 1000, "Max starvation backoff (µs)", type=int)
+mca.register("runtime_gc_defer", True,
+             "Stretch Python cyclic-GC thresholds while taskpools are in "
+             "flight (the mempool discipline of the reference: no "
+             "allocator churn in the hot path). Task/tile graphs are "
+             "cyclic and mostly LIVE mid-DAG, so frequent young-gen scans "
+             "only promote them and full collections walk the whole heap "
+             "— measured ~2x EP task throughput. Fully disabling GC "
+             "instead would leak jax buffer cycles and force a costly "
+             "whole-heap collect at quiescence (measured 3x on tiled "
+             "POTRF), so thresholds are stretched, not switched off",
+             type=bool)
+mca.register("debug_paranoid", 0,
+             "Assertion tier (ref: PARSEC_DEBUG_PARANOID): >0 adds runtime "
+             "invariant checks in the scheduling hot path (not-ready or "
+             "completed tasks entering the queues, double completion)",
+             type=int)
+
+
+# process-wide refcount for the GC-stretch window (several rank contexts
+# can live in one process; gc thresholds are global)
+_gc_defer_lock = threading.Lock()
+_gc_defer_count = 0
+_gc_saved_thresholds = None
+_GC_STRETCHED = (50_000, 20, 20)    # vs the (700, 10, 10) default
+
+
+def _gc_defer_acquire() -> None:
+    global _gc_defer_count, _gc_saved_thresholds
+    import gc
+    with _gc_defer_lock:
+        _gc_defer_count += 1
+        if _gc_defer_count == 1:
+            _gc_saved_thresholds = gc.get_threshold()
+            gc.set_threshold(*_GC_STRETCHED)
+
+
+def _gc_defer_release() -> None:
+    global _gc_defer_count, _gc_saved_thresholds
+    import gc
+    with _gc_defer_lock:
+        if _gc_defer_count == 0:
+            return
+        _gc_defer_count -= 1
+        if _gc_defer_count == 0 and _gc_saved_thresholds is not None:
+            gc.set_threshold(*_gc_saved_thresholds)
+            _gc_saved_thresholds = None
+
+
+class ExecutionStream:
+    """One worker's view of the runtime (ref: execution_stream.h:36-76)."""
+
+    __slots__ = ("th_id", "vp_id", "context", "next_task", "nb_selects",
+                 "nb_executed", "prof", "rng_state")
+
+    def __init__(self, th_id: int, context: "Context", vp_id: int = 0) -> None:
+        self.th_id = th_id
+        self.vp_id = vp_id
+        self.context = context
+        self.next_task: Optional[Task] = None   # es->next_task locality slot
+        self.nb_selects = 0
+        self.nb_executed = 0
+        self.prof = None
+        self.rng_state = (th_id * 2654435761) & 0xFFFFFFFF
+
+    @property
+    def is_master(self) -> bool:
+        return self.th_id == 0  # ref: PARSEC_THREAD_IS_MASTER
+
+
+class Context:
+    """Process-wide runtime (ref: parsec_context_t + parsec_init parsec.c:405)."""
+
+    def __init__(
+        self,
+        nb_cores: Optional[int] = None,
+        scheduler: Optional[str] = None,
+        argv: Optional[List[str]] = None,
+        my_rank: int = 0,
+        nb_ranks: int = 1,
+    ) -> None:
+        if argv:
+            mca.parse_cmdline(argv)
+        if nb_cores is None:
+            nb_cores = mca.get("runtime_nb_cores", 0) or (os.cpu_count() or 1)
+        self.nb_cores = max(1, nb_cores)
+        self.my_rank = my_rank
+        self.nb_ranks = nb_ranks
+        self.pins = pins_mod.PinsManager()
+        self.paranoid = mca.get("debug_paranoid", 0)
+        from .vpmap import VPMap
+        self.vpmap = VPMap(nb_threads=self.nb_cores)
+        self.streams: List[ExecutionStream] = [
+            ExecutionStream(i, self, vp_id=self.vpmap.thread_to_vp(i))
+            for i in range(self.nb_cores)
+        ]
+        self.sched = sched_mod.create(scheduler)
+        self.sched.install(self)
+        for s in self.streams:
+            self.sched.flow_init(s)
+        # device registry (lazy import to avoid cycles)
+        from ..device.device import DeviceRegistry
+        self.devices = DeviceRegistry(self)
+        self.comm = None            # set by parsec_tpu.comm when distributed
+        self.profiling = None       # set by utils.trace when enabled
+        self._taskpools: Dict[int, Taskpool] = {}
+        self._active = 0
+        self._cv = threading.Condition()
+        self._started = False
+        self._finalized = False
+        self._workers: List[threading.Thread] = []
+        self._work_event = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._prio_seen = False   # any nonzero-priority task ever scheduled
+        #: callables invoked when a progress loop starts or starves —
+        #: producers holding amortization buffers (the DTD ready batch)
+        #: drain here so direct _progress_loop users see their tasks
+        self._drain_hooks: List = []
+        # per-thread stream binding (was a thread-NAME parse on every
+        # schedule() — the single hottest line of the EP profile)
+        self._tls = threading.local()
+        self._tls.stream = self.streams[0]
+        # schedule() only needs to wake anyone when parked workers or a
+        # comm thread exist; single-core local runs skip the Event syscall
+        # (RemoteDepEngine flips this when it attaches)
+        self._need_wake = self.nb_cores > 1
+        self._gc_held = False
+        output.debug_verbose(2, "runtime",
+                             f"context up: {self.nb_cores} streams, sched={self.sched.name}")
+
+    # ------------------------------------------------------------------ setup
+    def add_taskpool(self, tp: Taskpool) -> None:
+        """parsec_context_add_taskpool (ref: scheduling.c:865-923)."""
+        if self._finalized:
+            output.fatal("context already finalized")
+        tp.context = self
+        if tp.termdet is None:
+            termdet_mod.LocalTermdet().monitor_taskpool(tp)  # ref: scheduling.c:879-884
+        with self._cv:
+            self._taskpools[tp.taskpool_id] = tp
+            self._active += 1
+            first = self._active == 1
+        if first and not self._gc_held and mca.get("runtime_gc_defer", True):
+            self._gc_held = True
+            _gc_defer_acquire()
+        # taskpool keeps one pending action for the enqueue itself
+        tp.addto_nb_pending_actions(1)
+        if tp.on_enqueue is not None:
+            tp.on_enqueue(tp)
+        if tp.startup_hook is not None:
+            startup = tp.startup_hook(self.streams[0], tp)
+            if startup:
+                self.schedule(startup, self.streams[0])
+        tp.termdet.taskpool_ready(tp)
+        tp.addto_nb_pending_actions(-1)
+        self._work_event.set()
+
+    def _taskpool_completed(self, tp: Taskpool) -> None:
+        with self._cv:
+            if tp.taskpool_id in self._taskpools:
+                del self._taskpools[tp.taskpool_id]
+                self._active -= 1
+            quiesced = self._active == 0
+            self._cv.notify_all()
+        if quiesced and self._gc_held:
+            self._gc_held = False
+            _gc_defer_release()
+
+    # ------------------------------------------------------------------ start/wait
+    def start(self) -> None:
+        """parsec_context_start (ref: scheduling.c:968): spawn workers, wake comm."""
+        if self._started:
+            return
+        self._started = True
+        if self.comm is not None:
+            self.comm.enable()
+        for s in self.streams[1:]:
+            t = threading.Thread(target=self._worker_main, args=(s,),
+                                 name=f"parsec-tpu-worker-{s.th_id}", daemon=True)
+            self._workers.append(t)
+            t.start()
+
+    def test(self) -> bool:
+        """parsec_context_test: True when no active taskpool remains."""
+        with self._cv:
+            return self._active == 0
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """parsec_context_wait (ref: scheduling.c:994): master joins the hot loop."""
+        self.start()
+        self._progress_loop(self.streams[0],
+                            until=lambda: self._active == 0,
+                            timeout=timeout)
+        return 0
+
+    def wait_taskpool(self, tp: Taskpool, timeout: Optional[float] = None) -> bool:
+        """parsec_taskpool_wait (ref: scheduling.c:1028)."""
+        self.start()
+        self._progress_loop(self.streams[0],
+                            until=lambda: tp.completed,
+                            timeout=timeout)
+        return tp.completed
+
+    def fini(self, timeout: Optional[float] = None) -> None:
+        """parsec_fini: drain and join workers; report statistics
+        (the per-thread usage + device statistics reports the reference
+        prints at shutdown, scheduling.c:47-90 / device.c). After a body
+        error the context is poisoned: fini skips the drain and tears down
+        cleanly instead of re-raising. With ``timeout``, a drain that cannot
+        finish (e.g. a peer rank died mid-graph) degrades to a warned
+        teardown instead of hanging forever."""
+        if self._finalized:
+            return
+        if self._error is None:
+            try:
+                self.wait(timeout=timeout)
+            except TimeoutError:
+                output.warning("fini: drain timed out with work outstanding; "
+                               "tearing down anyway")
+        self._finalized = True
+        for s in self.streams:
+            if s.nb_executed:
+                output.debug_verbose(1, "stats",
+                                     f"es{s.th_id} (vp{s.vp_id}): "
+                                     f"{s.nb_executed} tasks, "
+                                     f"{s.nb_selects} selects")
+        for name, st in self.devices.statistics().items():
+            if st["executed_tasks"]:
+                output.debug_verbose(1, "stats", f"device {name}: {st}")
+        self._work_event.set()
+        for t in self._workers:
+            t.join(timeout=5.0)
+        self.devices.fini()
+        if self.comm is not None:
+            self.comm.fini()
+        if self._gc_held:   # error paths can finalize with pools active
+            self._gc_held = False
+            _gc_defer_release()
+
+    # ------------------------------------------------------------------ scheduling
+    def schedule(self, tasks, stream: Optional[ExecutionStream] = None,
+                 distance: int = 0) -> None:
+        """__parsec_schedule (ref: scheduling.c:287)."""
+        if isinstance(tasks, Task):
+            tasks = [tasks]
+        tasks = list(tasks)
+        if not tasks:
+            return
+        if self.paranoid:
+            # PARANOID tier 1+ (ref: PARSEC_DEBUG_PARANOID build flavor):
+            # a task entering the ready queues must actually be ready, and
+            # must not already be completed/queued
+            for t in tasks:
+                # DTD tasks carry an explicit deps_remaining counter; PTG
+                # readiness lives in the repo goal tables (base Task has no
+                # such field)
+                unmet = getattr(t, "deps_remaining", 0)
+                if unmet > 0:
+                    output.fatal(f"PARANOID: {t!r} scheduled with "
+                                 f"{unmet} unmet dependencies")
+                if t.status == TASK_STATUS_COMPLETE:
+                    output.fatal(f"PARANOID: completed task {t!r} "
+                                 f"re-scheduled")
+        if not self._prio_seen:
+            # burst selection is only policy-sound while every live task
+            # has equal priority: the first prioritized task flips the hot
+            # loop to task-at-a-time selects so releases preempt promptly
+            for t in tasks:
+                if t.priority:
+                    self._prio_seen = True
+                    break
+        stream = stream or self._current_stream()
+        if self.pins.enabled:
+            self.pins.fire(pins_mod.SCHEDULE_BEGIN, stream, tasks)
+            self.sched.schedule(stream, tasks, distance)
+            self.pins.fire(pins_mod.SCHEDULE_END, stream, tasks)
+        else:
+            self.sched.schedule(stream, tasks, distance)
+        if self._need_wake:
+            self._work_event.set()
+
+    def _current_stream(self) -> ExecutionStream:
+        # threadlocal binding (workers bind in _worker_main); unknown
+        # threads (user code, comm thread) act as the master stream
+        return getattr(self._tls, "stream", None) or self.streams[0]
+
+
+    # ------------------------------------------------------------------ hot loop
+    def _worker_main(self, stream: ExecutionStream) -> None:
+        self._tls.stream = stream
+        if mca.get("runtime_bind_threads", False):
+            from .vpmap import bind_current_thread
+            bind_current_thread(self.vpmap.core_of(stream.th_id))
+        while not self._finalized:
+            self._progress_loop(stream, until=lambda: self._active == 0)
+            # park until new work shows up
+            self._work_event.wait(timeout=0.05)
+            self._work_event.clear()
+
+    def _progress_loop(self, stream: ExecutionStream, until, timeout=None) -> None:
+        """The hot loop (ref: __parsec_context_wait scheduling.c:789-818)."""
+        misses = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        backoff_max = mca.get("runtime_backoff_max_us", 1000) / 1e6
+        for h in tuple(self._drain_hooks):
+            h()
+        while not until():
+            if self._error is not None:
+                if stream.is_master:
+                    raise self._error
+                return  # workers park quietly; the master surfaces the error
+            did_something = False
+            # master progresses communications inline (ref: scheduling.c:790-798)
+            if stream.is_master and self.comm is not None:
+                did_something |= bool(self.comm.progress())
+            # poll device modules (our analogue of the GPU manager thread)
+            did_something |= bool(self.devices.progress(stream))
+            task = stream.next_task
+            stream.next_task = None
+            distance = 0
+            if task is None:
+                if self.pins.enabled:
+                    self.pins.fire(pins_mod.SELECT_BEGIN, stream, None)
+                    task, distance = self.sched.select(stream)
+                    self.pins.fire(pins_mod.SELECT_END, stream, task)
+                else:
+                    task, distance = self.sched.select(stream)
+                stream.nb_selects += 1
+            if task is not None:
+                misses = 0
+                # drain a burst before re-checking the loop conditions: the
+                # per-iteration overhead (until, error, comm, device polls)
+                # is pure cost for fine-grain tasks, and the scheduler pops
+                # the whole burst under ONE lock (select_burst). Bursts
+                # skip the SELECT pins events, so instrumentation keeps the
+                # task-at-a-time shape
+                budget = 1 if self.pins.enabled else 32
+                use_burst = not (self.pins.enabled or self._prio_seen)
+                batch: List[Task] = []
+                bi = 0
+                try:
+                    while True:
+                        self._task_progress(stream, task, distance)
+                        budget -= 1
+                        task = stream.next_task
+                        if task is not None:
+                            if budget <= 0:
+                                # outer loop consumes next_task; un-run
+                                # burst tasks go back to the queues
+                                if bi < len(batch):
+                                    self.sched.schedule(stream, batch[bi:], 0)
+                                break
+                            stream.next_task = None
+                            distance = 0
+                            continue
+                        if bi < len(batch):
+                            task = batch[bi]
+                            bi += 1
+                            distance = 0
+                            continue
+                        if budget <= 0:
+                            break
+                        if use_burst:
+                            batch = self.sched.select_burst(stream, budget)
+                            stream.nb_selects += 1
+                            bi = 0
+                            if not batch:
+                                break
+                            task = batch[0]
+                            bi = 1
+                        else:
+                            # prioritized workload: task-at-a-time selects
+                            # keep just-released high-priority work first
+                            task, distance = self.sched.select(stream)
+                            stream.nb_selects += 1
+                            if task is None:
+                                break
+                            continue
+                        distance = 0
+                except BaseException as e:  # noqa: BLE001
+                    # a failing body must surface to every waiter, not die
+                    # silently with one worker thread (ref: hook errors are
+                    # fatal, scheduling.c:541-548)
+                    if self._error is None:
+                        self._error = e
+                    if bi < len(batch):     # un-run burst tasks stay queued
+                        try:
+                            self.sched.schedule(stream, batch[bi:], 0)
+                        except Exception:
+                            pass
+                    self._work_event.set()
+                    if stream.is_master:
+                        raise
+                    return
+                did_something = True
+            if not did_something:
+                misses += 1
+                for h in tuple(self._drain_hooks):   # starving: drain any
+                    h()                              # amortization buffers
+                if deadline is not None and time.monotonic() > deadline:
+                    return
+                # exponential backoff while starving (ref: scheduling.c:801-804)
+                time.sleep(min(backoff_max, 1e-6 * (1 << min(misses, 10))))
+
+    # ------------------------------------------------------------------ task FSM
+    def _task_progress(self, stream: ExecutionStream, task: Task,
+                       distance: int = 0) -> int:
+        """__parsec_task_progress (ref: scheduling.c:507)."""
+        tc = task.task_class
+        if getattr(task, "nid", -1) >= 0 and not self.pins.enabled \
+                and not self.paranoid and tc.fast_inline and not tc.jit_ok:
+            # DTD native fast lane: eager CPU body, synchronous completion
+            # — one fused call replaces the prepare/execute/complete FSM
+            # (instrumented runs keep the full cycle for event symmetry)
+            task.taskpool._lean_cycle(stream, task)
+            return HOOK_DONE
+        if task.status < TASK_STATUS_PREPARE_INPUT:
+            task.status = TASK_STATUS_PREPARE_INPUT
+            pins_on = self.pins.enabled
+            if tc.prepare_input is None and not tc.flows and not pins_on:
+                # nothing to resolve — but only skip the PREPARE pins
+                # events when instrumentation is off (trace consumers pair
+                # intervals and must see symmetric streams per task)
+                return self._execute(stream, task)
+            if pins_on:
+                self.pins.fire(pins_mod.PREPARE_INPUT_BEGIN, stream, task)
+            if tc.prepare_input is not None:
+                rc = tc.prepare_input(stream, task)
+            else:
+                rc = self.generic_prepare_input(stream, task)
+            if pins_on:
+                self.pins.fire(pins_mod.PREPARE_INPUT_END, stream, task)
+            if rc == HOOK_AGAIN:
+                self.schedule([task], stream, distance)
+                return rc
+        return self._execute(stream, task)
+
+    def _execute(self, stream: ExecutionStream, task: Task) -> int:
+        """__parsec_execute (ref: scheduling.c:126)."""
+        tc = task.task_class
+        task.status = TASK_STATUS_HOOK
+        device = self.devices.select_best_device(task)  # ref: device.c:100
+        task.selected_device = device
+        for chore in tc.incarnations:
+            if not (chore.device_type & task.chore_mask):
+                continue
+            if device is not None and not (chore.device_type & device.type):
+                continue
+            if chore.evaluate is not None:
+                ev = chore.evaluate(stream, task)
+                if ev == HOOK_NEXT:
+                    continue
+                if ev == HOOK_DISABLE:
+                    task.chore_mask &= ~chore.device_type
+                    continue
+            task.selected_chore = chore
+            pins_on = self.pins.enabled
+            if pins_on:
+                self.pins.fire(pins_mod.EXEC_BEGIN, stream, task)
+            rc = chore.hook(stream, task)
+            stream.nb_executed += 1
+            # return-code dispatch (ref: scheduling.c:518-566)
+            if rc == HOOK_DONE:
+                if pins_on:
+                    self.pins.fire(pins_mod.EXEC_END, stream, task)
+                if device is not None:
+                    device.executed_tasks += 1  # async devices count in epilog
+                self.complete_task_execution(stream, task)
+                return rc
+            if rc == HOOK_ASYNC:
+                # completion arrives via complete_task_execution from a
+                # device; the EXEC interval closes here (it measures host
+                # dispatch — device execution shows on the device's own
+                # profiling stream)
+                if pins_on:
+                    self.pins.fire(pins_mod.EXEC_END, stream, task)
+                return rc
+            if rc == HOOK_AGAIN:
+                if pins_on:
+                    self.pins.fire(pins_mod.EXEC_END, stream, task)
+                self.schedule([task], stream, distance=1)  # __parsec_reschedule :445
+                return rc
+            if rc == HOOK_NEXT:
+                continue
+            if rc == HOOK_DISABLE:
+                task.chore_mask &= ~chore.device_type
+                continue
+            if rc == HOOK_ERROR:
+                output.fatal(f"task {task!r} hook failed")  # ref: scheduling.c:541-548
+        output.fatal(f"no runnable chore for task {task!r} "
+                     f"(chore_mask={task.chore_mask:#x})")
+        return HOOK_ERROR
+
+    def complete_task_execution(self, stream: ExecutionStream, task: Task) -> None:
+        """__parsec_complete_execution (ref: scheduling.c:469)."""
+        tc = task.task_class
+        if self.paranoid and task.status == TASK_STATUS_COMPLETE:
+            output.fatal(f"PARANOID: {task!r} completed twice")
+        task.status = TASK_STATUS_COMPLETE
+        pins_on = self.pins.enabled
+        if pins_on:
+            self.pins.fire(pins_mod.COMPLETE_EXEC_BEGIN, stream, task)
+        if tc.prepare_output is not None:
+            tc.prepare_output(stream, task)
+        if tc.complete_execution is not None:
+            tc.complete_execution(stream, task)
+        if pins_on:
+            self.pins.fire(pins_mod.RELEASE_DEPS_BEGIN, stream, task)
+        if tc.release_deps is not None:
+            tc.release_deps(stream, task)
+        else:
+            self.generic_release_deps(stream, task)
+        if pins_on:
+            self.pins.fire(pins_mod.RELEASE_DEPS_END, stream, task)
+            self.pins.fire(pins_mod.COMPLETE_EXEC_END, stream, task)
+        if task.on_complete is not None:
+            task.on_complete(task)
+        task.taskpool.addto_nb_tasks(-1)
+        if tc.release_task is not None:
+            tc.release_task(stream, task)
+
+    # ------------------------------------------------------------------ deps engine
+    def generic_prepare_input(self, stream: ExecutionStream, task: Task) -> int:
+        """Generic data_lookup: resolve input copies from repos / collections
+        (the role of the generated data_lookup, ref: jdf2c.c:45)."""
+        tp = task.taskpool
+        for flow in task.task_class.flows:
+            slot = task.data[flow.flow_index]
+            if slot.data_in is not None or flow.access & FLOW_ACCESS_CTL:
+                continue
+            for dep in flow.deps_in:
+                if dep.cond is not None and not dep.cond(task.locals):
+                    continue
+                if dep.task_class is None:
+                    # direct read from a data collection (JDF: "A <- A(k)")
+                    if dep.data_ref is not None:
+                        data = dep.data_ref(task.locals)
+                        slot.data_in = data.get_copy() if hasattr(data, "get_copy") else data
+                else:
+                    plocals_seq = dep.target_locals(task.locals) if dep.target_locals else [task.locals]
+                    plocals = plocals_seq[0] if not isinstance(plocals_seq, dict) else plocals_seq
+                    pkey = dep.task_class.make_key(tp, plocals)
+                    repo = tp.repos[dep.task_class.task_class_id]
+                    entry = repo.lookup_entry(pkey) if repo is not None else None
+                    if entry is None:
+                        output.fatal(f"missing repo entry {pkey} for {task!r} flow {flow.name}")
+                    slot.data_in = entry.data[dep.flow_index]
+                    slot.source_repo_entry = entry
+                break
+        return HOOK_DONE
+
+    def generic_release_deps(self, stream: ExecutionStream, task: Task) -> None:
+        """Generic release-deps (ref: parsec_release_dep_fct parsec.c:1837).
+
+        Walks output deps, updates successor dependency masks/counters
+        (parsec.c:1657), collects newly-ready tasks into a ring and schedules
+        it (scheduling keeps the highest-priority task as ``next_task``,
+        ref: __parsec_schedule_vp scheduling.c:360).
+        """
+        tp = task.taskpool
+        tc = task.task_class
+        ready: List[Task] = []
+        # publish produced copies into this class's repo for local successors
+        repo = tp.repos[tc.task_class_id]
+        # publish every flow that local successors will consume — written
+        # flows and forwarded reads alike (count_deps_fct role, parsec.c:1448)
+        wants_repo = repo is not None and any(
+            any(d.task_class is not None for d in f.deps_out)
+            for f in tc.flows if not (f.access & FLOW_ACCESS_CTL))
+        entry = None
+        nb_uses = 0
+        if wants_repo:
+            entry = repo.lookup_entry_and_create(task.key)
+            for f in tc.flows:
+                if f.deps_out and not (f.access & FLOW_ACCESS_CTL):
+                    slot = task.data[f.flow_index]
+                    out = slot.data_out if slot.data_out is not None else slot.data_in
+                    entry.data[f.flow_index] = out
+
+        distributed = self.comm is not None and self.nb_ranks > 1
+
+        def visit(dep, succ_locals: Dict[str, int]) -> bool:
+            succ_tc = dep.task_class
+            key = succ_tc.make_key(tp, succ_locals)
+            contribution = 1 if succ_tc.count_mode else (1 << dep.dep_index)
+            goal = (succ_tc.dependencies_goal_fn(succ_locals)
+                    if succ_tc.dependencies_goal_fn is not None else None)
+            if tp.update_deps(succ_tc, key, contribution, goal):
+                t = self.make_task(tp, succ_tc, dict(succ_locals))
+                ready.append(t)
+            return True
+
+        for flow in tc.flows:
+            # remote destinations grouped by the out-dep's named datatype:
+            # each type is reshaped ONCE before the wire and packed once per
+            # destination set (pre-send remote reshape, parsec/remote_dep.h:117;
+            # remote_multiple_outs_same_pred_flow.jdf)
+            remote_by_dtt: Dict[Optional[str], set] = {}
+            null_checked = False
+            for dep in flow.deps_out:
+                if dep.cond is not None and not dep.cond(task.locals):
+                    continue
+                if dep.task_class is None:
+                    continue  # write-back to memory handled by the body/copy model
+                if not null_checked and not (flow.access & FLOW_ACCESS_CTL):
+                    # forwarding no-data on a data flow is a program bug the
+                    # runtime must catch at the source (ref: "A NULL is
+                    # forwarded", parsec.c:1879; ptgpp forward_*_NULL tests)
+                    null_checked = True
+                    slot = task.data[flow.flow_index]
+                    out = slot.data_out if slot.data_out is not None \
+                        else slot.data_in
+                    if (out.payload if hasattr(out, "payload") else out) is None:
+                        output.fatal(
+                            f"A NULL is forwarded\n"
+                            f"\tfrom: {tc.name}{task.key} flow {flow.name}\n"
+                            f"\tto:   {dep.task_class.name}")
+                targets = dep.target_locals(task.locals) if dep.target_locals else [task.locals]
+                if isinstance(targets, dict):
+                    targets = [targets]
+                for tl in targets:
+                    if distributed:
+                        r = tp.task_rank_of(dep.task_class, tl)
+                        if r != self.my_rank:
+                            # remote successor: ship this flow's output once
+                            # per destination (the remote activation fork of
+                            # parsec_release_dep_fct); [type_remote]
+                            # overrides [type] on the wire
+                            wire = getattr(dep, "wire_datatype", dep.datatype)
+                            remote_by_dtt.setdefault(wire, set()).add(r)
+                            continue
+                    visit(dep, tl)
+                    nb_uses += 1
+            if remote_by_dtt:
+                slot = task.data[flow.flow_index]
+                out = slot.data_out if slot.data_out is not None else slot.data_in
+                payload = out.payload if hasattr(out, "payload") else out
+                dtt_of = getattr(tp, "_dtt", None)
+                ck = getattr(tc, "_ptg_canonical_key", None)
+                wire_key = ck(task) if ck is not None else task.key
+                for dtt_name, ranks in remote_by_dtt.items():
+                    wire_payload = payload
+                    if dtt_name is not None and dtt_of is not None:
+                        dtt = dtt_of(dtt_name)
+                        if dtt is not None and not dtt.identity:
+                            wire_payload = dtt.extract(payload)
+                    self.comm.ptg_send(tp, tc, wire_key, flow.flow_index,
+                                       wire_payload, sorted(ranks),
+                                       dtt=dtt_name)
+        if entry is not None:
+            repo.entry_addto_usage_limit(task.key, max(nb_uses, 1))
+        # consume source repo entries (one use each)
+        for flow in tc.flows:
+            slot = task.data[flow.flow_index]
+            if slot.source_repo_entry is not None:
+                slot.source_repo_entry._repo.entry_used_once(slot.source_repo_entry.key)
+        if ready:
+            ready.sort(key=lambda t: -t.priority)
+            # only claim the hot-path slot when it is free: device epilogs can
+            # release several tasks on the same stream within one progress
+            # sweep, and overwriting a pending next_task would lose it forever
+            # (mirrors __parsec_schedule_vp pushing the displaced task back)
+            if stream.next_task is None:
+                stream.next_task, rest = ready[0], ready[1:]
+            else:
+                rest = ready
+            if rest:
+                self.schedule(rest, stream)
+
+    def make_task(self, tp: Taskpool, tc: TaskClass,
+                  locals_: Dict[str, int], priority: Optional[int] = None) -> Task:
+        if priority is None:
+            prio = tc.properties.get("priority", 0)
+            priority = prio(locals_) if callable(prio) else prio
+        return Task(tp, tc, locals_, priority)
+
+
+# ---------------------------------------------------------------------------
+# module-level convenience mirroring parsec_init/parsec_fini
+# ---------------------------------------------------------------------------
+_default_context: Optional[Context] = None
+
+
+def init(nb_cores: Optional[int] = None, argv: Optional[List[str]] = None,
+         **kw) -> Context:
+    """parsec_init equivalent (ref: parsec/parsec.c:405)."""
+    global _default_context
+    if _default_context is None or _default_context._finalized:
+        _default_context = Context(nb_cores=nb_cores, argv=argv, **kw)
+    return _default_context
+
+
+def fini() -> None:
+    global _default_context
+    if _default_context is not None:
+        _default_context.fini()
+        _default_context = None

@@ -1,0 +1,87 @@
+"""Data repositories: produced copies held for successors.
+
+Re-design of parsec/datarepo.{c,h}. One repo per task class per taskpool; each
+entry is keyed by the producing task's key and holds the data copies it
+produced, one slot per flow. The retire protocol mirrors the reference
+(datarepo.h:74-90): an entry carries ``usagelmt`` (how many successor uses will
+happen) and ``usagecnt`` (how many happened); when they meet, the entry retires
+and its copies drop a reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class DataRepoEntry:
+    """Ref: data_repo_entry_t (parsec/datarepo.h:74-90)."""
+
+    __slots__ = ("key", "data", "usagelmt", "usagecnt", "retained", "_repo")
+
+    def __init__(self, repo: "DataRepo", key: Any, nb_flows: int) -> None:
+        self.key = key
+        self.data: List[Any] = [None] * nb_flows  # DataCopy per flow
+        self.usagelmt = 0
+        self.usagecnt = 0
+        self.retained = 0
+        self._repo = repo
+
+
+class DataRepo:
+    """Hash table of repo entries for one task class (ref: datarepo.c)."""
+
+    def __init__(self, nb_flows: int, name: str = "") -> None:
+        self.nb_flows = nb_flows
+        self.name = name
+        self._table: Dict[Any, DataRepoEntry] = {}
+        self._lock = threading.Lock()
+
+    def lookup_entry(self, key: Any) -> Optional[DataRepoEntry]:
+        with self._lock:
+            return self._table.get(key)
+
+    def lookup_entry_and_create(self, key: Any) -> DataRepoEntry:
+        """data_repo_lookup_entry_and_create: get-or-insert, retained."""
+        with self._lock:
+            e = self._table.get(key)
+            if e is None:
+                e = DataRepoEntry(self, key, self.nb_flows)
+                self._table[key] = e
+            e.retained += 1
+            return e
+
+    def entry_used_once(self, key: Any) -> None:
+        """data_repo_entry_used_once: one successor consumed its input."""
+        retire = None
+        with self._lock:
+            e = self._table.get(key)
+            if e is None:
+                return
+            e.usagecnt += 1
+            if e.usagelmt and e.usagecnt >= e.usagelmt and e.retained == 0:
+                retire = self._table.pop(key, None)
+        if retire is not None:
+            self._release(retire)
+
+    def entry_addto_usage_limit(self, key: Any, lmt: int) -> None:
+        """data_repo_entry_addto_usage_limit + release of the creator's retain."""
+        retire = None
+        with self._lock:
+            e = self._table.get(key)
+            if e is None:
+                return
+            e.usagelmt += lmt
+            e.retained = max(0, e.retained - 1)
+            if e.usagelmt and e.usagecnt >= e.usagelmt and e.retained == 0:
+                retire = self._table.pop(key, None)
+        if retire is not None:
+            self._release(retire)
+
+    def _release(self, entry: DataRepoEntry) -> None:
+        for copy in entry.data:
+            if copy is not None and hasattr(copy, "release"):
+                copy.release()
+
+    def __len__(self) -> int:
+        return len(self._table)

@@ -1,0 +1,181 @@
+"""Input pipeline: background host prefetch + device double-buffering.
+
+The training-loop feed layer (the role a native data loader plays in
+GPU-era frameworks, re-thought for TPU): the host side of a TPU program
+must keep the chip fed — batch assembly happens on CPU threads while the
+device computes, and the NEXT batch's host→HBM transfer overlaps the
+CURRENT step (double buffering via ``jax.device_put`` issued one batch
+ahead).
+
+* :class:`PrefetchLoader` — wraps any batch iterable; N worker threads
+  run the (user) batch function ahead of consumption into a bounded
+  queue (backpressure), then an optional device stage keeps ``ahead``
+  batches already transferred (sharded via a ``jax.sharding.Sharding``
+  when given — e.g. batch-over-dp for the GSPMD train steps).
+* :func:`token_batches` — the LM-side batch source: an infinite
+  shuffled stream of (tokens, targets) windows from a corpus array.
+
+No torch DataLoader / tf.data dependency: plain threads + queues, jax
+transfers. Deterministic per seed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+
+class PrefetchLoader:
+    """Iterate ``source`` with background prefetch and device staging.
+
+    ``source``: any iterable (or a zero-arg factory returning one, so the
+    loader can be re-iterated). Worker threads pull items and apply
+    ``fn`` (batch assembly — decode, augment, collate) off the consumer
+    thread. With ``sharding`` (or ``device``), finished batches are
+    pushed to the accelerator ``ahead`` batches early, overlapping
+    transfer with compute.
+
+    Ordering: with ``workers == 1`` (default) the stream order is
+    preserved; with more workers, batches arrive in completion order
+    (document the shuffle anyway — training feeds don't care).
+    """
+
+    def __init__(self, source, fn: Optional[Callable] = None,
+                 workers: int = 1, prefetch: int = 4,
+                 sharding=None, device=None, ahead: int = 2):
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        self._factory = source if callable(source) else (lambda: source)
+        self.fn = fn
+        self.workers = workers
+        self.prefetch = max(prefetch, workers)
+        self.place = sharding if sharding is not None else device
+        self.ahead = max(1, ahead)
+
+    # ------------------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        src = iter(self._factory())
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        src_lock = threading.Lock()
+        stop = threading.Event()
+        END = object()
+
+        def safe_put(msg) -> bool:
+            """Bounded put that aborts when the consumer is gone: a plain
+            q.put would block forever after an early consumer exit (the
+            finally drains once, workers refill, then everyone hangs in
+            the end-sentinel put) — one leaked thread per worker."""
+            while not stop.is_set():
+                try:
+                    q.put(msg, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            while not stop.is_set():
+                err = None
+                with src_lock:
+                    try:
+                        item = next(src)
+                    except StopIteration:
+                        break
+                    except Exception as e:      # noqa: BLE001
+                        err = e
+                # puts happen OUTSIDE src_lock: blocking on a full queue
+                # while holding the lock would stall every other worker
+                if err is not None:
+                    safe_put(("error", err))
+                    return
+                try:
+                    out = self.fn(item) if self.fn is not None else item
+                except Exception as e:          # noqa: BLE001
+                    safe_put(("error", e))
+                    return
+                if not safe_put(("item", out)):
+                    return
+            safe_put(("end", END))
+
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"prefetch-{i}")
+                   for i in range(self.workers)]
+        for t in threads:
+            t.start()
+
+        def raw():
+            ended = 0
+            try:
+                while ended < self.workers:
+                    kind, val = q.get()
+                    if kind == "end":
+                        ended += 1
+                        continue
+                    if kind == "error":
+                        stop.set()
+                        raise val
+                    yield val
+            finally:
+                stop.set()
+                # drain so blocked workers can observe stop and exit
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+
+        if self.place is None:
+            yield from raw()
+            return
+
+        # device stage: keep `ahead` batches already in flight to HBM
+        import jax
+
+        def put(b):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self.place), b)
+
+        pending = []
+        for batch in raw():
+            pending.append(put(batch))
+            if len(pending) > self.ahead:
+                yield pending.pop(0)
+        yield from pending
+
+    # ------------------------------------------------------------------
+    def __len__(self) -> int:
+        src = self._factory()
+        try:
+            return len(src)
+        except TypeError:
+            raise TypeError("underlying source has no length") from None
+
+
+def token_batches(corpus, batch: int, seq_len: int, seed: int = 0,
+                  n_batches: Optional[int] = None):
+    """An infinite (or ``n_batches``-bounded) stream of LM training pairs
+    ``(tokens, targets)`` — random ``seq_len + 1`` windows of ``corpus``
+    (1D int array), shuffled deterministically per ``seed``. Feed it to
+    :class:`PrefetchLoader` and a ``make_lm_*_train_step`` step."""
+    corpus = np.asarray(corpus)
+    if corpus.ndim != 1:
+        raise ValueError("corpus must be a 1D token array")
+    # valid starts: s + seq_len + 1 <= size, i.e. s in [0, size - seq_len)
+    hi = corpus.size - seq_len
+    if hi <= 0:
+        raise ValueError(f"corpus of {corpus.size} tokens is shorter than "
+                         f"seq_len + 1 = {seq_len + 1}")
+    rng = np.random.default_rng(seed)
+
+    def gen():
+        i = 0
+        while n_batches is None or i < n_batches:
+            starts = rng.integers(0, hi, size=batch)
+            win = np.stack([corpus[s:s + seq_len + 1] for s in starts])
+            yield win[:, :-1].astype(np.int32), win[:, 1:].astype(np.int32)
+            i += 1
+
+    return gen()

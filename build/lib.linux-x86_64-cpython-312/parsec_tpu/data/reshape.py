@@ -1,0 +1,156 @@
+"""Reshape engine: layout/datatype conversion between producer and consumer.
+
+Re-design of parsec/parsec_reshape.c: when a consumer declares a different
+datatype/layout than the producer's copy, the runtime inserts a *reshape
+promise* — a :class:`parsec_tpu.core.futures.DataCopyFuture` that converts
+lazily on first request and is shared by all consumers of that copy
+(ref: parsec_get_copy_reshape_from_dep, parsec_internal.h:688-696; local and
+pre-send remote reshapes, remote_dep.h:117).
+
+On TPU, layout conversions are device-side jitted ops (transpose, dtype
+cast, retile), so a reshape is one more async dispatch, fused by XLA with
+the consumer where possible.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.futures import DataCopyFuture
+from .data import COHERENCY_SHARED, Data, DataCopy
+
+
+@dataclass(frozen=True)
+class ReshapeSpec:
+    """Target layout: dtype and/or shape (None = keep)."""
+    dtype: Optional[str] = None
+    shape: Optional[Tuple[int, ...]] = None
+    transpose: bool = False
+
+
+def default_convert(src_copy: DataCopy, spec: ReshapeSpec) -> DataCopy:
+    """The default converter: cast / reshape / transpose on the host or
+    device array (jnp operations keep it on-device when the payload is a
+    device array)."""
+    x = src_copy.payload
+    try:
+        import jax.numpy as jnp
+        is_jax = not isinstance(x, np.ndarray)
+        xp = jnp if is_jax else np
+    except Exception:
+        xp = np
+    if spec.transpose:
+        x = xp.transpose(x)
+    if spec.shape is not None:
+        x = xp.reshape(x, spec.shape)
+    if spec.dtype is not None:
+        x = x.astype(spec.dtype)
+    out = DataCopy(src_copy.original, src_copy.device_index, x, COHERENCY_SHARED)
+    out.version = src_copy.version
+    return out
+
+
+class ReshapeCache:
+    """Per-copy promise cache: all consumers of (copy, spec) share one
+    conversion (ref: the reshape repo entries of parsec_reshape.c)."""
+
+    def __init__(self, convert: Callable[[DataCopy, ReshapeSpec], DataCopy] = default_convert) -> None:
+        self._convert = convert
+        self._promises: Dict[Tuple[int, ReshapeSpec], DataCopyFuture] = {}
+        self._lock = threading.Lock()
+
+    def promise(self, copy: DataCopy, spec: ReshapeSpec) -> DataCopyFuture:
+        key = (id(copy), spec)
+        with self._lock:
+            f = self._promises.get(key)
+            if f is None:
+                f = DataCopyFuture(copy, spec, self._convert)
+                self._promises[key] = f
+            return f
+
+    def get_reshaped(self, copy: DataCopy, spec: ReshapeSpec) -> DataCopy:
+        """Resolve (and possibly trigger) the conversion now."""
+        if not needs_reshape(copy, spec):
+            return copy
+        return self.promise(copy, spec).request()
+
+    def flush(self) -> None:
+        with self._lock:
+            for f in self._promises.values():
+                f.release()
+            self._promises.clear()
+
+
+class NamedDatatype:
+    """A named dep datatype: the (arena, datatype) pair a JDF dep carries
+    (ref: parsec_arena_datatype_t and the [type=...] dep annotations).
+
+    ``extract(arr)`` produces the typed view of a full tile (e.g. its lower
+    triangle); ``insert(dst, src)`` merges typed data back into a full tile
+    (the complement of dst is preserved). ``identity`` marks the DEFAULT
+    datatype: no conversion, consumers share the original copy (the
+    avoidable-reshape case, tests/collections/reshape/avoidable_reshape.jdf).
+    Hashable by name so one ReshapeCache promise is shared by every consumer
+    of (copy, datatype) — the single-copy guarantee of
+    input_dep_single_copy_reshape.jdf."""
+
+    __slots__ = ("name", "extract", "insert", "identity")
+
+    def __init__(self, name: str, extract: Optional[Callable] = None,
+                 insert: Optional[Callable] = None,
+                 identity: bool = False) -> None:
+        self.name = name
+        self.extract = extract if extract is not None else (lambda a: a)
+        self.insert = insert if insert is not None else (lambda dst, src: src)
+        self.identity = identity
+
+    def __hash__(self) -> int:
+        return hash(("NamedDatatype", self.name))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, NamedDatatype) and other.name == self.name
+
+    def __repr__(self) -> str:
+        return f"NamedDatatype({self.name!r})"
+
+    def convert(self, src_copy: DataCopy, _spec=None) -> DataCopy:
+        """ReshapeCache-compatible converter (spec == self)."""
+        out = DataCopy(src_copy.original, src_copy.device_index,
+                       self.extract(src_copy.payload), COHERENCY_SHARED)
+        out.version = src_copy.version
+        return out
+
+
+def lower_tile(dtype=None) -> NamedDatatype:
+    """The reference tests' LOWER_TILE: keep the (strictly including
+    diagonal) lower triangle, zero above."""
+    return NamedDatatype("LOWER_TILE",
+                         extract=lambda a: np.tril(np.asarray(a)),
+                         insert=lambda dst, src:
+                             np.triu(np.asarray(dst), 1) + np.tril(np.asarray(src)))
+
+
+def upper_tile(dtype=None) -> NamedDatatype:
+    return NamedDatatype("UPPER_TILE",
+                         extract=lambda a: np.triu(np.asarray(a)),
+                         insert=lambda dst, src:
+                             np.tril(np.asarray(dst), -1) + np.triu(np.asarray(src)))
+
+
+def default_datatype() -> NamedDatatype:
+    return NamedDatatype("DEFAULT", identity=True)
+
+
+def needs_reshape(copy: DataCopy, spec: ReshapeSpec) -> bool:
+    x = copy.payload
+    if spec.transpose:
+        return True
+    if spec.shape is not None and tuple(getattr(x, "shape", ())) != tuple(spec.shape):
+        return True
+    if spec.dtype is not None and str(getattr(x, "dtype", "")) != str(np.dtype(spec.dtype)):
+        return True
+    return False

@@ -1,0 +1,70 @@
+"""Subtile collections: recursive finer tiling of one tile.
+
+Re-design of parsec/data_dist/matrix/subtile.c: a collection viewing ONE
+tile of a parent collection as its own tiled matrix, the data substrate of
+recursive task execution (PARSEC_DEV_RECURSIVE): a coarse task spawns a
+nested taskpool over the subtile view of its tile, the nested tasks operate
+on sub-blocks, and the coarse tile sees the result.
+
+Host-side sub-blocks are numpy views sharing the parent buffer, so nested
+in-place-style updates compose; a ``flush`` writes the (possibly replaced)
+sub-blocks back into a fresh parent tile for the functional path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .collection import DataCollection
+from .data import COHERENCY_OWNED, Data
+from .matrix import TiledMatrix
+
+
+class SubtileCollection(TiledMatrix):
+    """Tiled view of one parent tile (ref: subtile_desc_create)."""
+
+    def __init__(self, parent_data: Data, mb: int, nb: int,
+                 name: str = "subtile") -> None:
+        src = parent_data.newest_copy()
+        if src is None:
+            raise ValueError("parent tile has no valid copy")
+        host = np.asarray(src.payload)
+        lm, ln = host.shape
+        super().__init__(name, lm, ln, mb, nb, dtype=host.dtype)
+        self.parent_data = parent_data
+        # one contiguous working buffer; sub-blocks are views into it
+        self._buffer = np.array(host, copy=True)
+
+    def _create_data(self, key):
+        m, n = self.key_to_indices(key)
+        r, c = self.tile_shape(m, n)
+        view = self._buffer[m * self.mb:m * self.mb + r,
+                            n * self.nb:n * self.nb + c]
+        d = Data(key=key, dc=self, shape=(r, c), dtype=self.dtype)
+        d.create_copy(0, view, COHERENCY_OWNED)
+        return d
+
+    def flush(self) -> None:
+        """Write the subtile results back into the parent tile (new buffer:
+        the parent's version advances like any task write)."""
+        out = np.array(self._buffer, copy=True)
+        for m in range(self.mt):
+            for n in range(self.nt):
+                d = self._datas.get(self.data_key(m, n))
+                if d is None:
+                    continue
+                c = d.newest_copy()
+                payload = np.asarray(c.payload)
+                r, co = self.tile_shape(m, n)
+                target = out[m * self.mb:m * self.mb + r,
+                             n * self.nb:n * self.nb + co]
+                if payload is not target.base and payload.base is not self._buffer:
+                    target[...] = payload[:r, :co]
+        host = self.parent_data.get_copy(0)
+        if host is None:
+            self.parent_data.create_copy(0, out, COHERENCY_OWNED)
+        else:
+            host.payload = out
+        self.parent_data.bump_version(0)

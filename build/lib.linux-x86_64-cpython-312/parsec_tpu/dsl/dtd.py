@@ -1,0 +1,1019 @@
+"""DTD: dynamic task discovery — the insert-task frontend.
+
+Re-design of parsec/interfaces/dtd (insert_function.c, insert_function.h,
+insert_function_internal.h). The user (on every rank, in the same order)
+inserts tasks against *tiles*; the runtime builds the DAG on the fly from each
+tile's access chain and executes tasks as their dependencies retire:
+
+* :class:`DTDTile` — ref: parsec_dtd_tile_t (insert_function_internal.h:174-196)
+  with ``last_writer`` / reader lists driving RAW/WAR/WAW chaining
+  (WAR strategy per overlap_strategies.c: a writer waits on all readers since
+  the previous write; readers wait on the last writer).
+* :class:`DTDTaskpool` — ref: parsec_dtd_taskpool_new (insert_function.c:1513);
+  task classes are auto-created per body function + parameter profile
+  (the reference's function_h_table); flow-control **window/threshold**
+  (insert_function.h:149-157): the inserter blocks past the window and helps
+  execute until the executed count catches up.
+* ``insert_task`` — ref: parsec_dtd_insert_task (insert_function.c:3617) →
+  create/initialize (:2801), param linking (:2896), schedule-if-ready (:2963).
+* distributed mode: every rank runs the same insert sequence; tasks filtered
+  by the affinity tile's rank (owner-computes); remote edges are forwarded to
+  the comm layer (rank_sent_to bitmaps, delayed release — wired in
+  :mod:`parsec_tpu.comm.remote_dep`).
+
+TPU-first shape: bodies are *functional* — ``fn(*args) -> outputs`` returns
+fresh arrays for its WRITE flows instead of mutating in place. The same body
+runs as the CPU chore (eager, host arrays) or the TPU chore (jitted once per
+task class, dispatched asynchronously to the chip). This keeps bodies jittable
+and makes version-tracked copies natural (every write is a new buffer).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.context import Context
+from ..core.task import (
+    Chore, DEV_ALL, DEV_CPU, DEV_TPU, Flow, FLOW_ACCESS_READ, FLOW_ACCESS_RW,
+    FLOW_ACCESS_WRITE, HOOK_DONE, TASK_STATUS_COMPLETE, Task, TaskClass,
+    Taskpool,
+)
+from ..data.collection import DataCollection
+from ..data.data import COHERENCY_OWNED, Data, data_from_array
+from ..device.tpu import TPUDevice, make_tpu_hook
+from ..utils import mca, output
+
+# access flags for insert_task args (ref: PARSEC_INPUT/OUTPUT/INOUT | AFFINITY)
+READ = FLOW_ACCESS_READ
+WRITE = FLOW_ACCESS_WRITE
+RW = FLOW_ACCESS_RW
+AFFINITY = 0x100          # ref: PARSEC_AFFINITY bit on a dtd param
+NOTRACK = 0x200           # ref: PARSEC_DONT_TRACK (dtd_test_flag_dont_track.c):
+                          # the tile's VALUE flows to the body, but the access
+                          # creates no RAW/WAR/WAW edges and no distributed
+                          # version bookkeeping — ordering w.r.t. tracked
+                          # accesses of the same tile is the caller's problem.
+                          # Rank-local by contract (like tile_new scratch).
+
+mca.register("dtd_window_size", 2048,
+             "Max in-flight inserted-but-not-executed tasks", type=int)
+mca.register("dtd_audit", False,
+             "Replay auditor: digest every rank's (tile, version, rank) "
+             "link decisions and compare across ranks at wait() (the DTD "
+             "analogue of the PTG iterators_checker)", type=bool)
+mca.register("dtd_threshold_size", 1024,
+             "Catch-up target once the window is hit", type=int)
+
+
+def _flush_body(arr):
+    """data_flush task body: force device->host materialization."""
+    return np.asarray(arr)
+
+
+class DTDTile:
+    """Ref: parsec_dtd_tile_t (insert_function_internal.h:174-196)."""
+
+    __slots__ = ("data", "key", "dc", "lock", "last_writer", "readers",
+                 "rank", "new_tile", "wcount", "writer_rank",
+                 "last_writer_version", "compact_at", "nid")
+
+    def __init__(self, data: Data, key: Any, dc: Optional[DataCollection],
+                 rank: int = 0, new_tile: bool = False) -> None:
+        self.data = data
+        self.key = key
+        self.dc = dc
+        self.lock = threading.Lock()
+        self.last_writer: Optional["DTDTask"] = None
+        self.readers: List["DTDTask"] = []
+        self.rank = rank
+        self.new_tile = new_tile
+        self.compact_at = 32      # next reader-list compaction watermark
+        #: logical write sequence number, identical on every rank because all
+        #: ranks replay the same insert sequence (the basis remote transfers
+        #: are keyed on, standing for the reference's output version tracking)
+        self.wcount = 0
+        self.writer_rank = rank      # rank holding the newest version
+        self.last_writer_version = 0
+        #: native-engine tile id (dsl chains in native/src/ptdtd.cpp);
+        #: assigned on first native-mode link. Tiles are POOL-local, so a
+        #: tile's chain lives entirely in one engine mode.
+        self.nid: Optional[int] = None
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<DTDTile {self.key}>"
+
+
+class DTDTask(Task):
+    """Task with runtime-discovered deps (ref: parsec_dtd_task_t)."""
+
+    __slots__ = ("deps_remaining", "successors", "completed", "lock",
+                 "arg_spec", "tiles", "rank", "pending_inputs",
+                 "remote_sends", "ident", "nid")
+
+    def __init__(self, taskpool, task_class, priority=0) -> None:
+        super().__init__(taskpool, task_class, None, priority)
+        self.ident = 0          # insertion index (repr/debug identity)
+        self.nid = -1           # native-engine task id (-1: Python engine)
+        # starts at 1: the insertion-in-progress guard (dropped at the end of
+        # insert_task, mirroring the count-then-activate protocol of
+        # parsec_dtd_schedule_task_if_ready, insert_function.c:2963)
+        self.deps_remaining = 1
+        self.completed = False
+        # Python-engine pools assign a real lock + successor list at insert
+        # (pred linking / release walk); the native lane never touches
+        # either (GIL-serialized engine), so allocation would be pure
+        # insert-path cost
+        self.successors: Optional[List[DTDTask]] = None
+        self.lock = None
+        self.arg_spec: List[Tuple[str, Any]] = []  # ('flow', i) | ('value', v)
+        self.tiles: List[Optional[DTDTile]] = []
+        self.rank = 0
+        #: flow_index -> payload delivered by the comm engine (exact-version
+        #: remote inputs override newest_copy resolution). Lazily allocated:
+        #: only distributed consumers need it, and a per-task dict is
+        #: GC-tracked churn on the insert hot path
+        self.pending_inputs: Optional[Dict[int, Any]] = None
+        #: id(tile) -> (tile, version, {dst ranks}) — the rank_sent_to
+        #: bitmap; lazily allocated for the same reason
+        self.remote_sends: Optional[Dict[int, Tuple]] = None
+
+    def dep_satisfied(self) -> bool:
+        with self.lock:
+            self.deps_remaining -= 1
+            return self.deps_remaining == 0
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{self.task_class.name}(#{self.ident})"
+
+
+#: process-wide jit cache keyed by the body function object, so the same body
+#: used across many taskpools compiles exactly once (jax.jit caches traces on
+#: the wrapper object — a fresh wrapper per task class would retrace).
+_jit_cache: Dict[Any, Any] = {}
+_jit_cache_lock = threading.Lock()
+
+
+def _vmapped(fn: Callable):
+    """jit(vmap(fn)) cached per body function (batched dispatch path)."""
+    key = ("__vmap__", fn)
+    j = _jit_cache.get(key)
+    if j is None:
+        with _jit_cache_lock:
+            j = _jit_cache.get(key)
+            if j is None:
+                import jax
+                j = jax.jit(jax.vmap(fn))
+                _jit_cache[key] = j
+    return j
+
+
+_host_dev_cache = [False, None]   # [resolved, device]
+
+
+def _host_device():
+    """The host jax device, resolved once (a per-task jax.local_devices()
+    lookup showed up in the benchmark profile). Only a successful lookup is
+    cached: a transient backend failure (flaky accelerator discovery) must
+    not latch None for the process lifetime."""
+    if not _host_dev_cache[0]:
+        try:
+            import jax
+            _host_dev_cache[1] = jax.local_devices(backend="cpu")[0]
+            _host_dev_cache[0] = True
+        except Exception:
+            return None
+    return _host_dev_cache[1]
+
+
+def _jitted(fn: Callable):
+    j = _jit_cache.get(fn)
+    if j is None:
+        with _jit_cache_lock:
+            j = _jit_cache.get(fn)
+            if j is None:
+                import jax
+                j = jax.jit(fn)
+                _jit_cache[fn] = j
+    return j
+
+
+class DTDTaskClass(TaskClass):
+    """Auto-created per (body fn, param profile)
+    (ref: function_h_table, insert_function_internal.h:206-224)."""
+
+    def __init__(self, name: str, fn: Callable, flow_accesses: Tuple[int, ...],
+                 nb_values: int, jit_ok: bool = True,
+                 batchable: bool = False) -> None:
+        super().__init__(name, nb_flows=len(flow_accesses))
+        self.fn = fn
+        self.count_mode = True
+        self.lazy_data = True     # fused lane retires tasks slot-free
+        self.flow_accesses = flow_accesses
+        #: False for side-effectful bodies (callbacks, host I/O): run eagerly
+        self.jit_ok = jit_ok
+        #: True: compatible queued device tasks collapse into one vmapped
+        #: dispatch (ref: dtd GPU batching flag on task-class chores)
+        self.batchable = batchable
+        for i, acc in enumerate(flow_accesses):
+            self.add_flow(Flow(f"f{i}", acc))
+
+    def jitted(self):
+        return _jitted(self.fn)
+
+    @property
+    def fast_inline(self) -> bool:
+        """True when this class can take the fused inline cycle: exactly
+        one synchronous CPU chore, no evaluate gate — completion is
+        immediate, so insert can run prepare->hook->complete in place."""
+        fi = getattr(self, "_fast_inline", None)
+        if fi is None:
+            fi = self._fast_inline = (
+                len(self.incarnations) == 1
+                and self.incarnations[0].device_type == DEV_CPU
+                and self.incarnations[0].evaluate is None)
+        return fi
+
+
+class DTDTaskpool(Taskpool):
+    """Ref: parsec_dtd_taskpool_new (insert_function.c:1513)."""
+
+    def __init__(self, context: Context, name: str = "dtd",
+                 capture=False) -> None:
+        # per-context (i.e. per-rank) sequence number per base name: every
+        # rank constructs its taskpools in the same order, so "dtd#3" means
+        # the same pool on all ranks while two concurrently-live pools can
+        # never collide in the remote-dep registry
+        seqs = getattr(context, "_dtd_name_seq", None)
+        if seqs is None:
+            seqs = context._dtd_name_seq = {}
+        seq = seqs.get(name, 0)
+        seqs[name] = seq + 1
+        if seq:
+            name = f"{name}#{seq}"
+        super().__init__(name)
+        self.ctx = context
+        self._classes: Dict[Any, DTDTaskClass] = {}
+        self._tiles: Dict[Any, DTDTile] = {}
+        self._tiles_lock = threading.Lock()
+        self.window_size = mca.get("dtd_window_size", 2048)
+        self.threshold_size = mca.get("dtd_threshold_size", 1024)
+        self.inserted = 0
+        self.local_inserted = 0   # tasks this rank actually executes
+        self.window_stalls = 0    # inserter blocked on the task window
+        self._executed = 0
+        self._exec_lock = threading.Lock()
+        self._open = False
+        self._touched_tiles: List[DTDTile] = []
+        self._new_tile_count = 0
+        self._audit = mca.get("dtd_audit", False)
+        self._audit_digest = 0      # zlib.crc32 chain: process-independent
+        self._audit_count = 0
+        #: native dependency engine (native/src/ptdtd.cpp) — the insert/
+        #: release hot path as a C extension. Decided at first insert:
+        #: single-rank, no comm engine, no audit (those stay on the Python
+        #: engine, which owns the distributed protocol bookkeeping)
+        self._neng = None
+        self._neng_decided = False
+        #: ready-at-insert batch (native lane only): single-stream contexts
+        #: gain nothing from per-task scheduler pushes, so ready tasks
+        #: buffer here and enter the scheduler in BULK at the drain points
+        #: (window stall, wait, close) — one push lock + one priority sort
+        #: per batch instead of per task
+        self._ready_buf: List[DTDTask] = []
+        self._last_class = None   # (fn, accs, nvals, jit, batch, tc)
+        if context.comm is not None:
+            # distributed: global termination detection + name-keyed registry
+            context.comm.fourcounter.monitor_taskpool(self)
+            context.comm.register_taskpool(self)
+        # hold the "user may still insert" action BEFORE attaching, so the
+        # termdet can never observe transiently-zero counters at enqueue time
+        # (the reference keeps the taskpool's own nb_pending_actions pinned
+        # while attached)
+        # whole-DAG capture mode (dsl/capture.py): record inserts, execute
+        # the entire pool as ONE jitted XLA program at wait()
+        self._capture = None
+        if capture:
+            if context.nb_ranks > 1:
+                output.fatal("graph capture is single-rank "
+                             "(a captured pool never leaves the chip)")
+            from .capture import GraphCapture
+            # capture=True -> "auto"; or an explicit "inline"/"scan" strategy
+            self._capture = GraphCapture(self, mode=capture)
+        self.addto_nb_pending_actions(1)
+        self._open = True
+        context.add_taskpool(self)
+
+    # ------------------------------------------------------------- tiles
+    def tile_of(self, dc: DataCollection, *indices) -> DTDTile:
+        """PARSEC_DTD_TILE_OF (ref: parsec_dtd_tile_of, insert_function.c:1403)."""
+        key = (dc.name, dc.data_key(*indices))
+        with self._tiles_lock:
+            t = self._tiles.get(key)
+            if t is None:
+                data = dc.data_of(*indices)
+                t = DTDTile(data, key, dc, rank=dc.rank_of(*indices))
+                self._tiles[key] = t
+                self._touched_tiles.append(t)
+            return t
+
+    def tile_of_key(self, dc: DataCollection, key: Any) -> DTDTile:
+        tkey = (dc.name, key)
+        with self._tiles_lock:
+            t = self._tiles.get(tkey)
+            if t is None:
+                data = dc.data_of_key(key)
+                t = DTDTile(data, tkey, dc, rank=dc.rank_of_key(key))
+                self._tiles[tkey] = t
+                self._touched_tiles.append(t)
+            return t
+
+    def tile_new(self, array_or_shape, dtype=np.float32, key: Any = None) -> DTDTile:
+        """parsec_dtd_tile_new (ref: insert_function.h:239): a taskpool-lifetime
+        scratch tile not backed by any collection."""
+        if hasattr(array_or_shape, "shape"):
+            arr = np.asarray(array_or_shape)
+        else:
+            arr = np.zeros(array_or_shape, dtype=dtype)
+        data = data_from_array(arr)
+        self._new_tile_count += 1
+        t = DTDTile(data, ("new", self.name, self._new_tile_count), None,
+                    rank=self.ctx.my_rank, new_tile=True)
+        with self._tiles_lock:
+            self._tiles[t.key] = t
+            self._touched_tiles.append(t)
+        return t
+
+    # ------------------------------------------------------------- classes
+    def _class_of(self, fn: Callable, flow_accesses: Tuple[int, ...],
+                  nb_values: int, name: Optional[str],
+                  jit_ok: bool = True, batchable: bool = False) -> DTDTaskClass:
+        key = (fn, flow_accesses, nb_values, jit_ok, batchable)
+        tc = self._classes.get(key)
+        if tc is None:
+            tc = DTDTaskClass(name or getattr(fn, "__name__", "dtd_task"),
+                              fn, flow_accesses, nb_values, jit_ok=jit_ok,
+                              batchable=batchable)
+            tc.prepare_input = self._prepare_input
+            tc.release_deps = self._release_deps
+            tc.complete_execution = self._complete_execution
+            # the TPU chore only exists where a TPU device does — on
+            # CPU-only contexts every task would walk (and fail) it first.
+            # Non-jittable bodies never get one: they would ride the whole
+            # async device pipeline (stage-in/events/epilog) only to run
+            # raw Python anyway — pure per-task overhead
+            if jit_ok and any(d.type & DEV_TPU
+                              for d in self.ctx.devices.devices):
+                tc.add_chore(Chore(DEV_TPU, self._tpu_hook))
+            tc.add_chore(Chore(DEV_CPU, self._cpu_hook))
+            self.add_task_class(tc)
+            self._classes[key] = tc
+        return tc
+
+    # ------------------------------------------------------------- insert
+    def _native_engine(self):
+        """The per-context native DTD engine, or None (gated)."""
+        if self._neng_decided:
+            return self._neng
+        self._neng_decided = True
+        ctx = self.ctx
+        # PINS instrumentation (profilers, the DOT grapher) walks Python
+        # successor lists and paired per-task events — pools first touched
+        # under instrumentation stay on the Python engine
+        if ctx.comm is not None or ctx.nb_ranks > 1 or self._audit \
+                or ctx.pins.enabled or not mca.get("native_enabled", True):
+            return None
+        eng = getattr(ctx, "_dtd_neng", None)
+        if eng is None and not getattr(ctx, "_dtd_neng_failed", False):
+            from .. import native as native_mod
+            mod = native_mod.load_ptdtd()
+            if mod is None:
+                ctx._dtd_neng_failed = True
+            else:
+                eng = ctx._dtd_neng = mod.Engine()
+                ctx._dtd_ntasks = {}
+        if eng is not None:
+            # progress loops drain our ready buffer even when the user
+            # drives the context directly (no tp.wait())
+            ctx._drain_hooks.append(self._flush_ready)
+        self._neng = eng
+        return eng
+
+    def _run_lean(self, task: "DTDTask", tc: "DTDTaskClass",
+                  tiles, arg_spec) -> None:
+        """Non-jittable fused body: resolve payloads straight from the
+        tiles, run eagerly, write WRITE flows back — the _cpu_hook eager
+        branch without TaskData slot churn (fused-inline path only)."""
+        pend = task.pending_inputs
+        payloads = []
+        for i, tile in enumerate(tiles):
+            p = pend.pop(i, None) if pend else None
+            if p is None:
+                copy = tile.data.newest_copy()
+                if copy is None:
+                    output.fatal(f"tile {tile!r} has no valid copy "
+                                 f"for {task!r}")
+                p = copy.payload
+            payloads.append(p)
+        vals = [payloads[v] if kind == "flow" else v for kind, v in arg_spec]
+        outs = tc.fn(*vals)
+        if outs is None:
+            outs = ()
+        elif not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        oi = 0
+        for i, acc in enumerate(tc.flow_accesses):
+            if acc & WRITE:
+                new = outs[oi] if oi < len(outs) else payloads[i]
+                oi += 1
+                data = tiles[i].data
+                host = data.get_copy(0)
+                if host is None:
+                    data.create_copy(0, new, COHERENCY_OWNED)
+                else:
+                    host.payload = new
+                data.bump_version(0)
+
+    def _lean_cycle(self, stream, task: "DTDTask") -> None:
+        """The fused select-side task cycle for native-lane eager bodies:
+        run, land outputs, retire, release successors — one call from the
+        progress loop instead of the generic prepare/execute/complete FSM
+        (the machinery a C runtime pays ~0 for; fusing it is how the
+        interpreted runtime stays in the reference's rate class)."""
+        tc = task.task_class
+        self._run_lean(task, tc, task.tiles, task.arg_spec)
+        stream.nb_executed += 1
+        task.status = TASK_STATUS_COMPLETE
+        task.completed = True
+        with self._exec_lock:
+            self._executed += 1
+        ready_ids = self._neng.complete(task.nid)
+        self.ctx._dtd_ntasks.pop(task.nid, None)
+        task.tiles = ()
+        task.arg_spec = ()
+        task.data = ()
+        task.pending_inputs = None
+        if ready_ids:
+            self._schedule_native_ready(ready_ids, stream)
+        self.addto_nb_tasks(-1)
+
+    def _schedule_native_ready(self, ready_ids, stream=None) -> None:
+        """Map newly-ready native task ids to their Python tasks and queue
+        them (shared by the release path and the fused-inline complete)."""
+        ntasks = self.ctx._dtd_ntasks
+        rtasks = []
+        for rid in ready_ids:
+            rt = ntasks[rid]
+            rt.deps_remaining = 0   # paranoid-check coherence
+            rtasks.append(rt)
+        self.ctx.schedule(rtasks, stream)
+
+    def _flush_ready(self) -> None:
+        """Hand the buffered ready-at-insert batch to the scheduler."""
+        if not self._ready_buf:
+            return
+        with self._exec_lock:
+            buf = self._ready_buf
+            self._ready_buf = []
+        if buf:
+            self.ctx.schedule(buf)
+
+    def _window_stall(self) -> None:
+        """Window flow control (ref: insert_function.h:149-157)."""
+        if self.local_inserted - self.executed > self.window_size:
+            self._flush_ready()
+            self.window_stalls += 1
+            target = self.local_inserted - self.threshold_size
+            self.ctx.start()
+            self.ctx._progress_loop(self.ctx.streams[0],
+                                    until=lambda: self.executed >= target)
+
+    def insert_task(self, fn: Callable, *args, priority: int = 0,
+                    where: int = DEV_ALL, name: Optional[str] = None,
+                    jit: bool = True, batch: bool = False) -> Optional[DTDTask]:
+        """parsec_dtd_insert_task (ref: insert_function.c:3617).
+
+        ``args``: ``(tile, access)`` tuples become data flows; anything else
+        is a by-value parameter. ``access`` may carry the AFFINITY bit to pick
+        the task's rank (default: first WRITE tile's rank) and/or the
+        NOTRACK bit to pass the tile's value without dependency tracking
+        (ref PARSEC_DONT_TRACK).
+        """
+        if not self._open:
+            output.fatal("insert_task on a closed DTD taskpool")
+        if self._capture is not None:
+            self._capture.record(fn, args, jit=jit, name=name or "")
+            self.inserted += 1
+            return None
+        flow_accesses: List[int] = []
+        arg_spec: List[Tuple[str, Any]] = []
+        tiles: List[DTDTile] = []
+        affinity_tile: Optional[DTDTile] = None
+        for a in args:
+            if isinstance(a, tuple) and len(a) == 2 and isinstance(a[0], DTDTile):
+                tile, acc = a
+                if acc & AFFINITY:
+                    affinity_tile = tile
+                acc &= ~AFFINITY
+                arg_spec.append(("flow", len(flow_accesses)))
+                flow_accesses.append(acc)
+                tiles.append(tile)
+            elif isinstance(a, DTDTile):
+                arg_spec.append(("flow", len(flow_accesses)))
+                flow_accesses.append(RW)
+                tiles.append(a)
+            else:
+                arg_spec.append(("value", a))
+        # one-entry class cache: the dominant pattern is a loop inserting
+        # the same body with the same flow shape (the reference's task
+        # class reuse), so the 5-tuple dict key is usually redundant
+        lc = self._last_class
+        if lc is not None and lc[0] is fn and lc[1] == flow_accesses \
+                and lc[2] == len(arg_spec) and lc[3] == jit and lc[4] == batch:
+            tc = lc[5]
+        else:
+            tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec),
+                                name, jit_ok=jit, batchable=batch)
+            self._last_class = (fn, list(flow_accesses), len(arg_spec),
+                                jit, batch, tc)
+        task = DTDTask(self, tc, priority)
+        task.arg_spec = arg_spec
+        task.tiles = tiles
+        task.ident = self.inserted
+        self.inserted += 1
+
+        neng = self._neng if self._neng_decided else self._native_engine()
+        if neng is not None:
+            # single-rank: owner-computes placement is the identity — the
+            # affinity scan below would always land on my_rank
+            task.rank = self.ctx.my_rank
+            # native fast lane (single-rank): per-tile chain linking, pred
+            # discovery, and the insertion-guard drop happen in ONE
+            # C-extension call; Python keeps the id->task map plus a cheap
+            # chain MIRROR (last_writer/readers/wcount) so tile
+            # introspection keeps its documented meaning
+            nids, naccs = [], []
+            for fi, (tile, acc) in enumerate(zip(tiles, flow_accesses)):
+                if acc & NOTRACK:
+                    copy = tile.data.newest_copy()
+                    if copy is not None:
+                        if task.pending_inputs is None:
+                            task.pending_inputs = {}
+                        task.pending_inputs[fi] = copy.payload
+                    continue
+                nid = tile.nid
+                if nid is None:
+                    nid = tile.nid = neng.tile()
+                nids.append(nid)
+                naccs.append(acc & 0x3)
+                if acc & WRITE:
+                    tile.last_writer = task
+                    tile.readers = []
+                    tile.compact_at = 32
+                    tile.wcount += 1
+                    tile.last_writer_version = tile.wcount
+                else:
+                    readers = tile.readers
+                    if len(readers) >= tile.compact_at:
+                        live = [r for r in readers if not r.completed]
+                        live.append(task)
+                        tile.readers = live
+                        tile.compact_at = max(32, 2 * len(live))
+                    else:
+                        readers.append(task)
+            tid, ndeps = neng.insert(nids, naccs)
+            task.nid = tid
+            task.deps_remaining = ndeps
+            self.ctx._dtd_ntasks[tid] = task
+            self.addto_nb_tasks(1)
+            li = self.local_inserted = self.local_inserted + 1
+            if ndeps == 0:
+                # ready now — but insert_task is ASYNCHRONOUS by contract
+                # (bodies run at the window stall / wait drain, never at
+                # insert): batch toward the scheduler so priorities stay
+                # policy-visible while the push cost amortizes. The GIL
+                # makes the bare append safe against a concurrent flush's
+                # swap-under-lock (the append lands in whichever list the
+                # load observed; a swapped-out list is scheduled AFTER the
+                # append by the same lock)
+                with self._exec_lock:
+                    buf = self._ready_buf
+                    buf.append(task)
+                if len(buf) >= 1024:
+                    self._flush_ready()
+            if li - self._executed > self.window_size:
+                self._window_stall()
+            return task
+
+        task.lock = threading.Lock()      # Python engine: preds/release lock
+        task.successors = []
+        # owner-computes rank (ref: rank from affinity tile's rank_of_key);
+        # untracked flows don't steer placement
+        if affinity_tile is None:
+            for t, acc in zip(tiles, flow_accesses):
+                if acc & WRITE and not acc & NOTRACK:
+                    affinity_tile = t
+                    break
+            if affinity_tile is None:
+                # fallback prefers tracked flows too: an untracked scratch
+                # tile is rank-local and would diverge owner-computes
+                # placement across the distributed replay
+                tracked = [t for t, acc in zip(tiles, flow_accesses)
+                           if not acc & NOTRACK]
+                if tracked:
+                    affinity_tile = tracked[0]
+                elif tiles:
+                    affinity_tile = tiles[0]
+        task.rank = affinity_tile.rank if affinity_tile is not None \
+            else self.ctx.my_rank
+
+        distributed = self.ctx.comm is not None and self.ctx.nb_ranks > 1
+        remote = distributed and task.rank != self.ctx.my_rank
+        # link against each tile's chain (ref: parsec_dtd_set_params_of_task
+        # insert_function.c:2896; WAR via overlap_strategies.c). In
+        # distributed mode every rank replays the same sequence, so the
+        # version bookkeeping below is globally consistent without messages.
+        for fi, (tile, acc) in enumerate(zip(tiles, flow_accesses)):
+            self._link_tile(task, tile, acc, fi, remote, distributed)
+        if remote:
+            # shadow task: executes elsewhere; local role is only data routing
+            self.ctx.comm.dtd_remote_task(self, task)
+            self._drop_insertion_guard(task, schedule=False)
+            return task
+        self.addto_nb_tasks(1)
+        self.local_inserted += 1
+        self._drop_insertion_guard(task, schedule=True)
+        self._window_stall()
+        return task
+
+    def _link_tile(self, task: DTDTask, tile: DTDTile, acc: int,
+                   flow_index: int, remote: bool, distributed: bool) -> None:
+        if acc & NOTRACK:
+            # untracked access: no chaining, no version bump, no comm
+            # bookkeeping, no audit entry — and the VALUE is snapshotted NOW
+            # (ref: insert_function.c:3038 captures tile->data_copy at insert
+            # time): an untracked flow has no ordering edges, so resolving
+            # newest_copy at execution would let the body observe a tracked
+            # write that landed after this insertion
+            copy = tile.data.newest_copy()
+            if copy is not None:
+                if task.pending_inputs is None:
+                    task.pending_inputs = {}
+                task.pending_inputs[flow_index] = copy.payload
+            return
+        my = self.ctx.my_rank
+        preds: List[DTDTask] = []
+        with tile.lock:
+            read_version = tile.wcount
+            src_rank = tile.writer_rank
+            # the producer of read_version — captured BEFORE the write side
+            # below replaces last_writer (the consumer must attach its send
+            # to the task that PRODUCES the version it reads, not to itself)
+            prev_writer = tile.last_writer
+            if acc & READ or not (acc & WRITE):
+                # RAW: predecessor is the last writer (local chain) or a
+                # remote version expectation / outbound send
+                if tile.last_writer is not None and \
+                        (not distributed or tile.last_writer.rank == my):
+                    preds.append(tile.last_writer)
+                if not remote:
+                    readers = tile.readers
+                    if len(readers) >= tile.compact_at:
+                        # amortized compaction: completed readers are
+                        # already-satisfied WAR predecessors — pruning them
+                        # keeps long read-chains (and the live object
+                        # graph) from growing unboundedly between writes.
+                        # The watermark doubles past the survivors so a
+                        # burst of never-retiring readers costs O(n log n)
+                        # total, not a full rescan per insert
+                        live = [r for r in readers if not r.completed]
+                        live.append(task)
+                        tile.readers = live
+                        tile.compact_at = max(32, 2 * len(live))
+                    else:
+                        readers.append(task)
+            if acc & WRITE:
+                # WAR: wait on local readers since the previous write; WAW on
+                # the local last writer (remote ones are covered by the
+                # version expectation on the READ side of RW, or need no
+                # local ordering at all)
+                for r in tile.readers:
+                    if not distributed or r.rank == my:
+                        preds.append(r)
+                if tile.last_writer is not None and \
+                        (not distributed or tile.last_writer.rank == my) and \
+                        tile.last_writer not in preds:
+                    preds.append(tile.last_writer)
+                tile.last_writer = task
+                tile.readers = []
+                tile.compact_at = 32
+                tile.wcount += 1
+                tile.last_writer_version = tile.wcount
+                tile.writer_rank = task.rank
+        if self._audit and not tile.new_tile:
+            # deterministic digest of this link decision (crc32: stable
+            # across processes, unlike str hash under PYTHONHASHSEED): all
+            # ranks replay the same COLLECTION-BACKED inserts, so the
+            # chains must agree (tile_new scratch tiles are rank-local by
+            # contract and excluded)
+            import zlib
+            item = repr((tile.key, acc & 0x3, read_version, src_rank,
+                         task.rank)).encode()
+            self._audit_digest = zlib.crc32(item, self._audit_digest)
+            self._audit_count += 1
+        if distributed:
+            comm = self.ctx.comm
+            needs_data = bool(acc & READ)   # pure WRITE flows ship nothing
+            if not remote and needs_data and src_rank != my:
+                # local consumer of a remotely-produced version
+                comm.expect(self, task, tile, read_version, src_rank,
+                            flow_index)
+            elif remote and needs_data and src_rank == my:
+                # remote consumer of a locally-held/produced version
+                comm.note_send(self, tile, read_version, task.rank,
+                               writer=prev_writer)
+        if remote:
+            return
+        seen = set()
+        for p in preds:
+            if id(p) in seen or p is task:
+                continue
+            seen.add(id(p))
+            with p.lock:
+                if not p.completed:
+                    p.successors.append(task)
+                    with task.lock:
+                        task.deps_remaining += 1
+
+    def _drop_insertion_guard(self, task: DTDTask, schedule: bool) -> None:
+        if task.dep_satisfied() and schedule:
+            # ref: parsec_dtd_schedule_task_if_ready (insert_function.c:2963)
+            self.ctx.schedule([task])
+
+    # ------------------------------------------------------------- hooks
+    def _prepare_input(self, stream, task: DTDTask) -> int:
+        if task.data is None:     # lazy_data: first touch allocates
+            from ..core.task import TaskData
+            task.data = [TaskData()
+                         for _ in range(task.task_class.nb_flows)]
+        pending = task.pending_inputs
+        for i, tile in enumerate(task.tiles):
+            pend = pending.pop(i, None) if pending else None
+            if pend is not None:
+                # remote exact-version payload (may differ from newest_copy
+                # when versions raced in through the network out of order);
+                # an unattached copy: carries the right Data for write-back
+                # without perturbing newest_copy resolution
+                from ..data.data import DataCopy
+                task.data[i].data_in = DataCopy(tile.data, 0, pend)
+                continue
+            copy = tile.data.newest_copy()
+            if copy is None:
+                output.fatal(f"tile {tile!r} has no valid copy for {task!r}")
+            task.data[i].data_in = copy
+        return HOOK_DONE
+
+    def _gather_args(self, task: DTDTask, flow_payloads: Sequence[Any]) -> List[Any]:
+        vals = []
+        for kind, v in task.arg_spec:
+            if kind == "flow":
+                vals.append(flow_payloads[v])
+            else:
+                vals.append(v)
+        return vals
+
+    def _apply_outputs(self, task: DTDTask, outs) -> List[Any]:
+        if outs is None:
+            outs = ()
+        elif not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return list(outs)
+
+    def _jittable(self, task: DTDTask) -> bool:
+        if not task.task_class.jit_ok:
+            return False
+        return all(kind != "value" or isinstance(v, (int, float, np.number, np.ndarray))
+                   for kind, v in task.arg_spec)
+
+    def _cpu_hook(self, stream, task: DTDTask) -> int:
+        tc: DTDTaskClass = task.task_class
+        payloads = [s.data_in.payload if s.data_in is not None else None
+                    for s in task.data]
+        vals = self._gather_args(task, payloads)
+        # jit the body on the host backend too: eager per-op dispatch is the
+        # dominant cost for jax-expressed bodies (compiled once per class)
+        if self._jittable(task):
+            fn = tc.jitted()
+            cpu = _host_device()
+            import jax
+            conv = []
+            for v in vals:
+                if isinstance(v, (int, float)):
+                    v = np.asarray(v)
+                elif cpu is not None and isinstance(v, np.ndarray):
+                    v = jax.device_put(v, cpu)
+                conv.append(v)
+            # persist converted flow payloads on their copies: each tile
+            # crosses into the backend ONCE per DAG instead of on every
+            # consuming task (the dominant re-copy cost for READ panels).
+            # Only when the conversion is lossless — device_put canonicalizes
+            # 64-bit dtypes under default x64-disabled jax, and that must
+            # stay confined to the jitted computation, not the stored copy
+            for (kind, fi), cv in zip(task.arg_spec, conv):
+                if kind == "flow":
+                    slot = task.data[fi]
+                    if slot.data_in is not None and \
+                            isinstance(slot.data_in.payload, np.ndarray) and \
+                            getattr(cv, "dtype", None) == slot.data_in.payload.dtype:
+                        slot.data_in.payload = cv
+            if cpu is not None:
+                with jax.default_device(cpu):
+                    outs = self._apply_outputs(task, fn(*conv))
+            else:
+                outs = self._apply_outputs(task, fn(*conv))
+        else:
+            outs = self._apply_outputs(task, tc.fn(*vals))
+        oi = 0
+        for i, acc in enumerate(tc.flow_accesses):
+            if acc & WRITE:
+                tile = task.tiles[i]
+                new = outs[oi] if oi < len(outs) else payloads[i]
+                oi += 1
+                copy = task.data[i].data_in
+                host = tile.data.get_copy(0)
+                if host is None:
+                    host = tile.data.create_copy(0, new, COHERENCY_OWNED)
+                else:
+                    host.payload = new
+                tile.data.bump_version(0)
+                task.data[i].data_out = host
+        return HOOK_DONE
+
+    def _tpu_hook(self, stream, task: "DTDTask") -> int:
+        """TPU chore: enqueue on the selected device, with batching metadata
+        (plays the generated GPU hook role, jdf2c.c:6613)."""
+        from ..device.tpu import TPUTask, _run_inline
+        dev = task.selected_device
+        if dev is None or not isinstance(dev, TPUDevice):
+            return _run_inline(stream, task, self._tpu_submit)
+        tc: DTDTaskClass = task.task_class
+        batchable = tc.batchable and self._jittable(task)
+        gt = TPUTask(task, self._tpu_submit, batchable=batchable,
+                     batch_submit=self._tpu_batch_submit if batchable else None)
+        return dev.kernel_scheduler(stream, task, tpu_task=gt)
+
+    def _tpu_batch_submit(self, device: TPUDevice, tasks: List["DTDTask"],
+                          inputs_list: List[List[Any]]):
+        """One vmapped dispatch over a batch of compatible independent tasks
+        (they are mutually independent by construction: only dependency-free
+        tasks sit in the device queue)."""
+        import jax
+        import jax.numpy as jnp
+        tc: DTDTaskClass = tasks[0].task_class
+        vals_list = [self._gather_args(t, inp)
+                     for t, inp in zip(tasks, inputs_list)]
+        stacked = []
+        for i in range(len(vals_list[0])):
+            col = [np.asarray(v) if isinstance(v, (int, float)) else v
+                   for v in (vals[i] for vals in vals_list)]
+            stacked.append(jnp.stack(col))
+        vm = _vmapped(tc.fn)
+        outs = vm(*stacked)
+        if outs is None:
+            return [() for _ in tasks]
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return [tuple(o[i] for o in outs) for i in range(len(tasks))]
+
+    def _tpu_submit(self, device: TPUDevice, task: DTDTask, inputs: List[Any]):
+        """TPU chore body: call the jitted class function on device arrays.
+
+        Non-jittable bodies (non-numeric by-value args) fall back to eager;
+        JAX still dispatches the ops asynchronously.
+        """
+        tc: DTDTaskClass = task.task_class
+        vals = self._gather_args(task, inputs)
+        jittable = self._jittable(task)
+        fn = tc.jitted() if jittable else tc.fn
+        if jittable:
+            vals = [np.asarray(v) if isinstance(v, (int, float)) else v
+                    for v in vals]
+        outs = self._apply_outputs(task, fn(*vals))
+        # order outputs by WRITE flows (contract shared with device epilog)
+        return tuple(outs)
+
+    def _complete_execution(self, stream, task: DTDTask) -> int:
+        with self._exec_lock:
+            self._executed += 1
+        return HOOK_DONE
+
+    @property
+    def executed(self) -> int:
+        return self._executed
+
+    def _release_deps(self, stream, task: DTDTask) -> None:
+        """DTD successor release (ref: parsec_dtd_ordering_correctly,
+        insert_function_internal.h:277): flip completed, wake successors."""
+        if task.nid >= 0:
+            # native fast lane: the successor walk + newly-ready collection
+            # is one C-extension call (no per-successor locks — the GIL
+            # already serializes engine access)
+            task.completed = True
+            ready_ids = self._neng.complete(task.nid)
+            self.ctx._dtd_ntasks.pop(task.nid, None)
+            task.tiles = ()
+            task.arg_spec = ()
+            task.data = ()
+            task.pending_inputs = None
+            if ready_ids:
+                self._schedule_native_ready(ready_ids, stream)
+            return
+        with task.lock:
+            task.completed = True
+            succs = task.successors
+            task.successors = []
+        # ship remote sends FIRST: the payload references must be captured
+        # before any released successor can rebind the tile's host copy
+        if self.ctx.comm is not None:
+            self.ctx.comm.dtd_task_completed(self, task)
+        # retire the task's object graph (the mempool-return moment of
+        # parsec_dtd_release_task): dropping the tile/copy references here
+        # lets refcounting reclaim payload buffers immediately and keeps
+        # the completed shell acyclic, so deferred GC at quiescence walks
+        # shells, not the whole DAG
+        task.tiles = ()
+        task.arg_spec = ()
+        task.data = ()
+        task.pending_inputs = None
+        ready = [s for s in succs if s.dep_satisfied()]
+        if ready:
+            self.ctx.schedule(ready, stream)
+
+    # ------------------------------------------------------------- flush/wait
+    def data_flush(self, tile: DTDTile) -> None:
+        """parsec_dtd_data_flush (ref: parsec_dtd_data_flush.c): insert a task
+        that writes the tile's newest version back home (host copy of the
+        owner)."""
+        self.insert_task(_flush_body, (tile, RW), name="dtd_flush", jit=False)
+
+    def data_flush_all(self, dc: DataCollection) -> None:
+        """parsec_dtd_data_flush_all: flush every tile of ``dc`` seen so far."""
+        with self._tiles_lock:
+            tiles = [t for t in self._touched_tiles if t.dc is dc]
+        for t in tiles:
+            self.data_flush(t)
+
+    def wait_mesh(self, mesh, axis_names=None) -> bool:
+        """Capture-mode only: execute the recorded DAG as ONE GSPMD program
+        over ``mesh`` — collection tiles become slices of sharded global
+        arrays, XLA partitions the work and inserts the ICI transfers
+        (see dsl/capture.py:execute_mesh)."""
+        if self._capture is None:
+            output.fatal("wait_mesh requires DTDTaskpool(capture=True)")
+        self._capture.execute_mesh(mesh, axis_names)
+        return True
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """parsec_dtd_taskpool_wait: drain everything this rank executes."""
+        if self._capture is not None:
+            self._capture.execute()
+            return True
+        if self._audit and self.ctx.comm is not None and self.ctx.nb_ranks > 1:
+            # replay audit BEFORE blocking on completion: a divergent insert
+            # sequence surfaces as a fatal here instead of a silent hang
+            self.ctx.comm.audit_check(self, self._audit_digest,
+                                      self._audit_count)
+        self._flush_ready()
+        self.ctx.start()
+        target = self.local_inserted
+        self.ctx._progress_loop(self.ctx.streams[0],
+                                until=lambda: self.executed >= target and
+                                self.nb_tasks == 0,
+                                timeout=timeout)
+        return self.executed >= target
+
+    def close(self) -> None:
+        """End of insertion: drop the open action so termination can fire."""
+        if self._capture is not None and self._capture.ops:
+            # scheduler-mode inserts execute without an explicit wait();
+            # captured ops must not be silently dropped on close
+            self._capture.execute()
+        self._flush_ready()
+        if self._neng is not None:
+            try:
+                self.ctx._drain_hooks.remove(self._flush_ready)
+            except ValueError:
+                pass
+        if self._open:
+            self._open = False
+            self.addto_nb_pending_actions(-1)
+
+    def __enter__(self) -> "DTDTaskpool":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.wait()
+        self.close()

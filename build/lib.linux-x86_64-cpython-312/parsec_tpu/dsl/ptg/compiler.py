@@ -1,0 +1,886 @@
+"""PTG compiler: ProgramSpec → runtime task classes.
+
+Stands where the reference's jdf2c.c code generator stands (SURVEY §2.5:
+structure/symbols/flows/deps/startup/init/ctor/keys/hooks/data_lookup/
+release_deps/iterate_successors), but instead of emitting C against the
+task-class contract it *builds* :class:`parsec_tpu.core.task.TaskClass`
+objects directly:
+
+* parameter ranges → the startup enumerator counting the task space and
+  seeding ready tasks (the generated startup/internal_init, jdf2c.c:3047,3455)
+* guarded in-deps → ``prepare_input`` (the generated data_lookup, jdf2c.c:45)
+  + per-task dependency goals (count mode — the DYNAMIC_HASH_TABLE dep mode)
+* guarded out-deps → ``Dep`` descriptors consumed by the generic
+  release-deps engine (iterate_successors, jdf2c.c:47)
+* BODY blocks → chores: the body text becomes a Python function of
+  (params..., flows...) returning its written flows, jitted once per class —
+  a PTG body IS an XLA executable on TPU (the BODY[type=TPU] goal of
+  BASELINE.json)
+* memory out-deps → write-back to the data collection at completion
+
+Python expressions are compiled once at class-build time and evaluated
+against task locals + user globals.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...core.context import Context
+from ...core.datarepo import DataRepo
+from ...core.task import (
+    Chore, DEV_CPU, DEV_TPU, Dep, Flow, FLOW_ACCESS_CTL, FLOW_ACCESS_READ,
+    FLOW_ACCESS_RW, FLOW_ACCESS_WRITE, HOOK_DONE, Task, TaskClass, Taskpool,
+)
+from ...core.futures import DataCopyFuture
+from ...data.data import COHERENCY_OWNED, DataCopy
+from ...data.reshape import NamedDatatype, default_datatype
+from ...device.tpu import make_tpu_hook
+from ...utils import mca, output
+from . import parser as P
+
+mca.register("ptg_agglomerate", True,
+             "Execute statically-independent flowless PTG classes "
+             "as one fused sweep at startup (no per-task "
+             "scheduling cycle)", type=bool)
+
+_ACCESS_MAP = {
+    P.FLOW_READ: FLOW_ACCESS_READ,
+    P.FLOW_WRITE: FLOW_ACCESS_WRITE,
+    P.FLOW_RW: FLOW_ACCESS_RW,
+    P.FLOW_CTL: FLOW_ACCESS_CTL,
+}
+
+
+def _payload_of(v: Any) -> Any:
+    return v.payload if isinstance(v, DataCopy) else v
+
+
+class _Expr:
+    """One compiled Python expression evaluated against task locals."""
+
+    __slots__ = ("code", "src")
+    is_range = False
+
+    def __init__(self, src: str) -> None:
+        self.src = src = src.strip()
+        try:
+            self.code = compile(src, f"<ptg:{src}>", "eval")
+        except SyntaxError as e:
+            raise P.PTGSyntaxError(f"bad expression {src!r}: {e}") from e
+
+    def __call__(self, env: Dict[str, Any]) -> Any:
+        return eval(self.code, env)  # noqa: S307 - the DSL is code by design
+
+    def values(self, env: Dict[str, Any]) -> List[int]:
+        return [int(self(env))]
+
+
+class _RangeExpr:
+    """A JDF range endpoint index ``lo .. hi`` — broadcast/gather fan-out
+    (e.g. ``-> Y WORK(0 .. W-1)`` multicasts one output to many tasks)."""
+
+    __slots__ = ("lo", "hi")
+    is_range = True
+
+    def __init__(self, lo: str, hi: str) -> None:
+        self.lo = _Expr(lo)
+        self.hi = _Expr(hi)
+
+    def values(self, env: Dict[str, Any]) -> List[int]:
+        return list(range(int(self.lo(env)), int(self.hi(env)) + 1))
+
+
+def _index_expr(src: str):
+    # top-level '..' only (not inside parens/brackets)
+    depth = 0
+    for i, c in enumerate(src):
+        if c in "([":
+            depth += 1
+        elif c in ")]":
+            depth -= 1
+        elif c == "." and depth == 0 and src[i:i+2] == ".." and src[i:i+3] != "...":
+            return _RangeExpr(src[:i], src[i+2:])
+    return _Expr(src)
+
+
+class PTGTaskpool(Taskpool):
+    """A taskpool instantiated from a PTG program."""
+
+    def __init__(self, program: "PTGProgram", ctx: Context,
+                 globals_: Dict[str, Any],
+                 collections: Dict[str, Any],
+                 name: Optional[str] = None,
+                 datatypes: Optional[Dict[str, NamedDatatype]] = None) -> None:
+        super().__init__(name or program.spec.name)
+        self.program = program
+        self.ctx = ctx
+        # named dep datatypes (the arenas_datatypes table of the generated
+        # taskpool, ref parsec_internal.h:42-47); DEFAULT is the identity
+        self.datatypes: Dict[str, NamedDatatype] = {"DEFAULT": default_datatype()}
+        self.datatypes.update(datatypes or {})
+        #: (id(source payload), dtt name) -> DataCopyFuture — the reshape
+        #: promise table: every consumer of (copy, datatype) shares ONE
+        #: conversion (ref: parsec_reshape.c repo entries;
+        #: input_dep_single_copy_reshape.jdf)
+        self._typed_cache: Dict[Tuple[int, str], DataCopyFuture] = {}
+        self._typed_lock = threading.Lock()
+        #: compiled out-dep tables per (producer class, flow) for the
+        #: guard-exact producer-datatype lookup
+        self._odt_cache: Dict[Tuple[str, str], List] = {}
+        self.env_base: Dict[str, Any] = {"__builtins__": {}}
+        self.env_base.update({
+            "min": min, "max": max, "abs": abs, "range": range, "len": len,
+            "int": int, "divmod": divmod,
+        })
+        prologue_names: Dict[str, Any] = {}
+        if program.spec.prologue:
+            # the '%{...%}' host-language escape (jdf2c.c:54): full Python,
+            # executed once per instantiation; its definitions become
+            # program globals visible to ranges, guards, and bodies
+            pns: Dict[str, Any] = {"np": np}
+            try:
+                exec(compile(program.spec.prologue,  # noqa: S102
+                             f"<ptg-prologue:{program.spec.name}>", "exec"),
+                     pns)
+            except Exception as e:
+                output.fatal(f"PTG taskpool {self.name}: prologue failed: {e}")
+            prologue_names = {k: v for k, v in pns.items()
+                              if not k.startswith("__") and k != "np"}
+            self.env_base.update(prologue_names)
+        self.env_base.update(globals_)
+        self.collections = collections
+        missing = [g for g in program.spec.globals
+                   if g not in globals_ and g not in collections
+                   and g not in prologue_names]
+        if missing:
+            output.fatal(f"PTG taskpool {self.name}: missing globals {missing}")
+        #: (tc_name, pkey, flow_index) -> payload shipped from a remote
+        #: producer (consumed by prepare_input)
+        self._ptg_received: Dict[Tuple, Any] = {}
+        self._ptg_lock = threading.Lock()
+        self._build()
+        if ctx.comm is not None and ctx.nb_ranks > 1:
+            # distributed PTG: global termination + name-keyed routing
+            ctx.comm.fourcounter.monitor_taskpool(self)
+            ctx.comm.register_taskpool(self)
+
+    # ------------------------------------------------------------------ build
+    def _build(self) -> None:
+        spec = self.program.spec
+        self._classes: Dict[str, TaskClass] = {}
+        # pass 1: shells
+        for tcs in spec.task_classes:
+            tc = TaskClass(tcs.name, nb_locals=len(tcs.params))
+            tc.count_mode = True
+            for fs in tcs.flows:
+                tc.add_flow(Flow(fs.name, _ACCESS_MAP[fs.access]))
+            tc.make_key = (lambda params: (
+                lambda tp, loc: tuple(loc[p] for p in params)
+            ))(tcs.params)
+            # the wire always carries the canonical parameter tuple, even
+            # when make_key_fn customizes the local hash key (the receiving
+            # rank re-derives locals from it)
+            tc._ptg_canonical_key = (lambda params: (
+                lambda task: tuple(task.locals[p] for p in params)
+            ))(tcs.params)
+            self.add_task_class(tc)
+            self.repos[tc.task_class_id] = DataRepo(tc.nb_flows, tcs.name)
+            self._classes[tcs.name] = tc
+        # pass 2: deps, goals, hooks
+        for tcs in spec.task_classes:
+            self._build_class(tcs, self._classes[tcs.name])
+        self.startup_hook = self._startup
+
+    def _env(self, locals_: Dict[str, int]) -> Dict[str, Any]:
+        env = dict(self.env_base)
+        env.update(locals_)
+        return env
+
+    def _build_class(self, tcs: P.TaskClassSpec, tc: TaskClass) -> None:
+        spec = self.program.spec
+        # ranges
+        ranges = [(r.param, _Expr(r.lo_expr), _Expr(r.hi_expr), _Expr(r.step_expr))
+                  for r in tcs.ranges]
+        # order ranges by parameter declaration order
+        order = {p: i for i, p in enumerate(tcs.params)}
+        ranges.sort(key=lambda r: order[r[0]])
+        tc._ptg_ranges = ranges
+        tc._ptg_spec = tcs
+        # header property block (ref: udf.jdf user-defined functions):
+        # names resolve against the taskpool globals at instantiate time
+        mk_fn = self._resolve_callable(tcs, "make_key_fn",
+                                       tcs.header_props.get("make_key_fn"))
+        if mk_fn is not None:
+            # user-defined task key (ref: udf.jdf ud_make_key): fn(tp,
+            # locals) -> hashable key used by the dep repo/hash tables
+            tc.make_key = mk_fn
+        te_fn = self._resolve_callable(tcs, "time_estimate",
+                                       tcs.header_props.get("time_estimate"))
+        if te_fn is not None:
+            # feeds best-device selection (ref: parsec_internal.h:431-458
+            # time_estimate; consumed by DeviceRegistry.select_best_device)
+            tc.time_estimate = te_fn
+        tc._ptg_startup_fn = self._resolve_callable(
+            tcs, "startup_fn", tcs.header_props.get("startup_fn"))
+
+        if tcs.priority_expr:
+            prio = _Expr(tcs.priority_expr)
+            tc.properties["priority"] = lambda loc, _p=prio: int(_p(self._env(loc)))
+        if tcs.affinity is not None:
+            aff_name = tcs.affinity.name
+            aff_exprs = [_Expr(e) for e in tcs.affinity.index_exprs]
+            def affinity_rank(loc, _n=aff_name, _e=aff_exprs):
+                dc = self.collections.get(_n)
+                if dc is None:
+                    return 0
+                env = self._env(loc)
+                return dc.rank_of(*[ex(env) for ex in _e])
+            tc._ptg_rank_of = affinity_rank
+        else:
+            tc._ptg_rank_of = lambda loc: 0
+
+        # in-deps: per flow, ordered guarded alternatives
+        in_specs: List[List[Tuple]] = []
+        for fs in tcs.flows:
+            alts = []
+            for d in fs.deps:
+                if d.direction != "in":
+                    continue
+                guard = _Expr(d.guard) if d.guard else None
+                alts.append((guard, self._mk_ep(d.endpoint, d.dtt)))
+                if d.else_endpoint is not None:
+                    alts.append(("else", self._mk_ep(d.else_endpoint, d.dtt)))
+            in_specs.append(alts)
+        tc._ptg_in_specs = in_specs
+
+        def active_in(alts: List[Tuple], env: Dict[str, Any]):
+            taken = False
+            for guard, ep in alts:
+                if guard is None:
+                    return ep
+                if guard == "else":
+                    if not taken:
+                        return ep
+                    continue
+                taken = bool(guard(env))
+                if taken:
+                    return ep
+            return None
+
+        def goal_fn(loc: Dict[str, int]) -> int:
+            env = self._env(loc)
+            goal = 0
+            for alts in in_specs:
+                ep = active_in(alts, env)
+                if ep is not None and ep["kind"] == "task":
+                    n = 1
+                    for ex in ep["exprs"]:
+                        if ex.is_range:
+                            n *= len(ex.values(env))
+                    goal += n
+            return goal
+
+        tc.dependencies_goal_fn = goal_fn
+        tc._ptg_active_in = active_in
+        for fs, alts in zip(tcs.flows, in_specs):
+            if fs.access == P.FLOW_CTL:
+                continue
+            for _guard, ep in alts:
+                if ep and ep["kind"] == "task" and \
+                        any(ex.is_range for ex in ep["exprs"]):
+                    raise P.PTGSyntaxError(
+                        f"{tcs.name}.{fs.name}: range gather is only valid "
+                        f"on CTL flows (a data flow has exactly one input)")
+
+        # out-deps -> generic-engine Dep descriptors
+        for fi, fs in enumerate(tcs.flows):
+            flow = tc.flows[fi]
+            for d in fs.deps:
+                if d.direction != "out":
+                    continue
+                self._add_out_dep(tc, flow, d.guard, d.endpoint, dtt=d.dtt,
+                                  dtt_remote=d.dtt_remote)
+                if d.else_endpoint is not None:
+                    self._add_out_dep(tc, flow, d.guard, d.else_endpoint,
+                                      negate=True, dtt=d.dtt,
+                                      dtt_remote=d.dtt_remote)
+
+        # hooks — flowless classes (the EP shape) skip the data hooks
+        # entirely instead of paying per-task env construction for nothing
+        tc.prepare_input = self._mk_prepare_input(tc) if tc.flows else None
+        if any(getattr(f, "_ptg_mem_out", None) for f in tc.flows):
+            tc.complete_execution = self._mk_complete(tc)
+        nb_bodies = 0
+        for body in tcs.bodies:
+            fn = self._compile_body(tcs, body)
+            if nb_bodies == 0:
+                tc._ptg_body_fn = fn    # cross-DSL replay (pins ptg_to_dtd)
+            # [evaluate = fn]: per-incarnation gate (ref: udf.jdf evaluate
+            # properties selecting the chore); fn(stream, task) -> HOOK_*
+            evaluate = self._resolve_callable(tcs, "evaluate", body.evaluate)
+            if body.device == "TPU":
+                tc.add_chore(Chore(DEV_TPU, make_tpu_hook(
+                    self._mk_tpu_submit(tc, fn)), evaluate=evaluate))
+                # TPU bodies also serve as host chores through the same
+                # jitted function (degrades to the CPU backend off-pod)
+                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn),
+                                   evaluate=evaluate))
+            else:
+                tc.add_chore(Chore(DEV_CPU, self._mk_cpu_hook(tc, fn),
+                                   evaluate=evaluate))
+            nb_bodies += 1
+
+    def _resolve_callable(self, tcs: P.TaskClassSpec, prop: str,
+                          name: Optional[str]):
+        """Resolve a user-function property name against the taskpool
+        globals; fatal when it does not name a callable."""
+        if name is None:
+            return None
+        fn = self.env_base.get(name)
+        if not callable(fn):
+            output.fatal(f"{tcs.name}: property {prop}={name!r} does not "
+                         f"name a callable in the taskpool globals")
+        return fn
+
+    def _mk_ep(self, ep: Optional[P.Endpoint],
+               dtt: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        if ep is None:
+            return None
+        return {
+            "kind": ep.kind,
+            "name": ep.name,
+            "flow": ep.flow,
+            "exprs": [_index_expr(e) for e in ep.index_exprs],
+            "dtt": dtt,
+        }
+
+    # ------------------------------------------------------------- datatypes
+    def _dtt(self, name: Optional[str]) -> Optional[NamedDatatype]:
+        if name is None:
+            return None
+        d = self.datatypes.get(name)
+        if d is None:
+            output.fatal(f"PTG taskpool {self.name}: dep references unknown "
+                         f"datatype {name!r} (registered: "
+                         f"{sorted(self.datatypes)})")
+        return d
+
+    def _typed_payload(self, value: Any, dtt: Optional[NamedDatatype]) -> Any:
+        """Reshape-promise path (ref: parsec_get_copy_reshape_from_dep,
+        parsec_internal.h:688-696): the conversion runs lazily, ONCE, and is
+        shared by every consumer of (source copy, datatype). Identity
+        datatypes return the original untouched (avoidable_reshape.jdf)."""
+        if dtt is None or dtt.identity:
+            return value
+        payload = _payload_of(value)
+        key = (id(payload), dtt.name)
+        with self._typed_lock:
+            fut = self._typed_cache.get(key)
+            if fut is None:
+                src = value if isinstance(value, DataCopy) \
+                    else DataCopy(None, 0, payload)
+                fut = DataCopyFuture(src, dtt, lambda c, d: d.convert(c))
+                self._typed_cache[key] = fut
+        return fut.request()
+
+    def _out_dep_table(self, peer_name: str, peer_flow: str) -> List:
+        """Compiled (guard, [(which, class, flow, index_exprs)], dtt, wire)
+        rows for a producer flow's out-deps (compiled once per edge)."""
+        key = (peer_name, peer_flow)
+        tbl = self._odt_cache.get(key)
+        if tbl is None:
+            tbl = []
+            pf = self.program.spec.task_class(peer_name).flow(peer_flow)
+            for d in (pf.deps if pf is not None else []):
+                if d.direction != "out":
+                    continue
+                g = _Expr(d.guard) if d.guard else None
+                eps = {}
+                for which, ep in (("then", d.endpoint),
+                                  ("else", d.else_endpoint)):
+                    if ep is not None and ep.kind == "task":
+                        eps[which] = (ep.name, ep.flow,
+                                      [_index_expr(e) for e in ep.index_exprs])
+                wire = d.dtt_remote if d.dtt_remote is not None else d.dtt
+                tbl.append((g, eps, d.dtt, wire))
+            self._odt_cache[key] = tbl
+        return tbl
+
+    def _producer_out_dtt(self, peer_name: str, peer_flow: str,
+                          my_class: str, my_flow: str,
+                          plocals: Dict[str, int],
+                          my_key: Tuple[int, ...]
+                          ) -> Tuple[Optional[str], Optional[str]]:
+        """(local [type], wire type) the producer declared on the out-dep
+        that ACTUALLY feeds this task — guards evaluated under the
+        producer's locals and the fan-out index set checked against my key
+        (a flow may have several typed edges to the same class/flow behind
+        different guards)."""
+        env = self._env(plocals)
+        import itertools
+        for g, eps, dtt, wire in self._out_dep_table(peer_name, peer_flow):
+            # guard/index exceptions propagate: the sender side evaluates
+            # the same expressions (dep.cond / target_locals) and lets them
+            # raise, and the two ends of a remote edge must agree
+            which = "then"
+            if g is not None:
+                which = "then" if bool(g(env)) else "else"
+            ep = eps.get(which)
+            if ep is None or ep[0] != my_class or ep[1] != my_flow:
+                continue
+            axes = [ex.values(env) for ex in ep[2]]
+            if tuple(my_key) not in set(itertools.product(*axes)):
+                continue
+            return dtt, wire
+        return None, None
+
+    def _add_out_dep(self, tc: TaskClass, flow: Flow, guard: Optional[str],
+                     ep: P.Endpoint, negate: bool = False,
+                     dtt: Optional[str] = None,
+                     dtt_remote: Optional[str] = None) -> None:
+        gexpr = _Expr(guard) if guard else None
+
+        def cond(loc, _g=gexpr, _n=negate):
+            if _g is None:
+                return True
+            v = bool(_g(self._env(loc)))
+            return (not v) if _n else v
+
+        if ep.kind == "task":
+            peer_tc = self._classes[ep.name]
+            peer_spec = self.program.spec.task_class(ep.name)
+            peer_flow_idx = next(i for i, f in enumerate(peer_spec.flows)
+                                 if f.name == ep.flow)
+            exprs = [_index_expr(e) for e in ep.index_exprs]
+
+            def target_locals(loc, _e=exprs, _params=tuple(peer_spec.params)):
+                env = self._env(loc)
+                import itertools
+                axes = [ex.values(env) for ex in _e]
+                return [dict(zip(_params, combo))
+                        for combo in itertools.product(*axes)]
+
+            dep = Dep(
+                task_class=peer_tc, flow_index=peer_flow_idx,
+                dep_index=len(flow.deps_out), cond=cond,
+                target_locals=target_locals,
+                datatype=dtt)        # named datatype (local reshape)
+            # [type_remote] overrides the wire datatype only — local
+            # successors keep the original copy (local_no_reshape.jdf)
+            dep.wire_datatype = dtt_remote if dtt_remote is not None else dtt
+            flow.deps_out.append(dep)
+        elif ep.kind == "memory":
+            exprs = [_Expr(e) for e in ep.index_exprs]
+            flow._ptg_mem_out = getattr(flow, "_ptg_mem_out", [])
+            flow._ptg_mem_out.append((cond, ep.name, exprs, dtt))
+        # 'null' endpoints: data is dropped
+
+    # ------------------------------------------------------------------ hooks
+    def _mk_prepare_input(self, tc: TaskClass):
+        my_class = tc._ptg_spec.name
+        my_flows = [f.name for f in tc._ptg_spec.flows]
+
+        def prepare_input(stream, task: Task) -> int:
+            env = self._env(task.locals)
+            # datatype resolution always compares CANONICAL parameter
+            # tuples, independent of any user make_key_fn hash key
+            canonical_key = tc._ptg_canonical_key(task)
+            for fi, flow in enumerate(tc.flows):
+                if flow.access & FLOW_ACCESS_CTL:
+                    # control deps carry no data: their only job (the
+                    # dependency count) was done at the producer's release
+                    continue
+                alts = tc._ptg_in_specs[fi]
+                ep = tc._ptg_active_in(alts, env)
+                if ep is None:
+                    continue
+                slot = task.data[fi]
+                in_dtt = self._dtt(ep.get("dtt"))
+                if ep["kind"] == "memory":
+                    dc = self.collections.get(ep["name"])
+                    if dc is None:
+                        output.fatal(f"unknown collection {ep['name']!r}")
+                    data = dc.data_of(*[ex(env) for ex in ep["exprs"]])
+                    copy = data.newest_copy()
+                    if in_dtt is not None and not in_dtt.identity:
+                        # read-reshape: a NEW typed datacopy, shared by all
+                        # consumers of (copy, datatype) via the promise table
+                        slot.data_in = self._typed_payload(copy, in_dtt)
+                    else:
+                        # unattached wrapper: body outputs never mutate the
+                        # collection implicitly (write-back = explicit out-deps)
+                        slot.data_in = DataCopy(None, 0, _payload_of(copy))
+                elif ep["kind"] == "task":
+                    peer = self._classes[ep["name"]]
+                    peer_spec = self.program.spec.task_class(ep["name"])
+                    pkey = tuple(ex.values(env)[0] for ex in ep["exprs"])
+                    pf_idx = next(i for i, f in enumerate(peer_spec.flows)
+                                  if f.name == ep["flow"])
+                    plocals = dict(zip(peer_spec.params, pkey))
+                    out_dtt_name, wire_dtt_name = self._producer_out_dtt(
+                        ep["name"], ep["flow"], my_class, my_flows[fi],
+                        plocals, canonical_key)
+                    if (self.ctx.nb_ranks > 1 and self.ctx.comm is not None
+                            and self.task_rank_of(peer, plocals) != self.ctx.my_rank):
+                        # remote producer: payload was shipped by its rank,
+                        # ALREADY reshaped to the out-dep type before the
+                        # wire (pre-send reshape); never re-reshape with the
+                        # same type (remote_no_re_reshape.jdf). The arrival
+                        # is keyed by wire datatype so one flow fanning out
+                        # under several types delivers each shape intact
+                        # (remote_multiple_outs_same_pred_flow.jdf)
+                        with self._ptg_lock:
+                            got = self._ptg_received.get(
+                                (ep["name"], pkey, pf_idx, wire_dtt_name))
+                        if got is None:
+                            output.fatal(f"{task!r}: remote payload "
+                                         f"{ep['name']}{pkey} missing")
+                        payload, wire_dtt = got
+                        if in_dtt is not None and not in_dtt.identity \
+                                and in_dtt.name != wire_dtt:
+                            slot.data_in = self._typed_payload(payload, in_dtt)
+                        else:
+                            slot.data_in = DataCopy(None, 0, payload)
+                        continue
+                    repo = self.repos[peer.task_class_id]
+                    # repo entries are stored under the producer's task key,
+                    # which may come from a user make_key_fn
+                    entry = repo.lookup_entry(peer.make_key(self, plocals))
+                    if entry is None:
+                        output.fatal(f"{task!r}: missing repo entry "
+                                     f"{ep['name']}{pkey}")
+                    value = entry.data[pf_idx]
+                    # output-reshape (producer's [type]) then input-reshape
+                    # (this dep's [type]) when they differ; identical names
+                    # convert exactly once (avoidable_reshape.jdf)
+                    out_dtt = self._dtt(out_dtt_name)
+                    value = self._typed_payload(value, out_dtt)
+                    if in_dtt is not None and (out_dtt is None
+                                               or in_dtt.name != out_dtt.name):
+                        value = self._typed_payload(value, in_dtt)
+                    slot.data_in = value
+                    slot.source_repo_entry = entry
+                elif ep["kind"] == "new":
+                    slot.data_in = None
+            return HOOK_DONE
+        return prepare_input
+
+    def _body_inputs(self, tc: TaskClass, task: Task) -> List[Any]:
+        vals = [task.locals[p] for p in tc._ptg_spec.params]
+        for fi, flow in enumerate(tc.flows):
+            if flow.access & FLOW_ACCESS_CTL:
+                continue
+            vals.append(_payload_of(task.data[fi].data_in))
+        return vals
+
+    def _store_outputs(self, tc: TaskClass, task: Task, outs) -> None:
+        if outs is None:
+            outs = ()
+        elif not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        oi = 0
+        for fi, flow in enumerate(tc.flows):
+            if flow.access & FLOW_ACCESS_CTL or not (flow.access & FLOW_ACCESS_WRITE):
+                continue
+            if oi < len(outs):
+                task.data[fi].data_out = outs[oi]
+            oi += 1
+
+    def _mk_cpu_hook(self, tc: TaskClass, fn):
+        if not tc.flows:
+            # flowless class (the EP/control-task shape): no arrays flow
+            # through the body, so the jit wrapper is pure dispatch
+            # overhead — run the raw python body
+            raw = getattr(fn, "__wrapped__", fn)
+            tc._ptg_raw_body = raw      # the agglomerated-sweep entry
+
+            def flowless_hook(stream, task: Task) -> int:
+                raw(*[task.locals[p] for p in tc._ptg_spec.params])
+                return HOOK_DONE
+            return flowless_hook
+
+        def hook(stream, task: Task) -> int:
+            outs = fn(*self._body_inputs(tc, task))
+            self._store_outputs(tc, task, outs)
+            return HOOK_DONE
+        return hook
+
+    def _mk_tpu_submit(self, tc: TaskClass, fn):
+        def submit(device, task: Task, inputs: List[Any]):
+            vals = [task.locals[p] for p in tc._ptg_spec.params]
+            for fi, flow in enumerate(tc.flows):
+                if flow.access & FLOW_ACCESS_CTL:
+                    continue
+                vals.append(inputs[fi])
+            return fn(*vals)
+        return submit
+
+    def _mk_complete(self, tc: TaskClass):
+        def complete(stream, task: Task) -> int:
+            env = self._env(task.locals)
+            for fi, flow in enumerate(tc.flows):
+                mem_outs = getattr(flow, "_ptg_mem_out", None)
+                if not mem_outs:
+                    continue
+                slot = task.data[fi]
+                value = slot.data_out if slot.data_out is not None else \
+                    _payload_of(slot.data_in)
+                value = _payload_of(value)
+                for cond, dc_name, exprs, dtt_name in mem_outs:
+                    if not cond(task.locals):
+                        continue
+                    dc = self.collections.get(dc_name)
+                    data = dc.data_of(*[ex(env) for ex in exprs])
+                    host = data.get_copy(0)
+                    dtt = self._dtt(dtt_name)
+                    if host is None:
+                        v = value if dtt is None or dtt.identity \
+                            else dtt.extract(value)
+                        data.create_copy(0, v, COHERENCY_OWNED)
+                    elif dtt is not None and not dtt.identity:
+                        # typed write-back merges only the datatype's region
+                        # into the tile; the complement is preserved
+                        host.payload = dtt.insert(host.payload, value)
+                    else:
+                        host.payload = value
+                    data.bump_version(0)
+            return HOOK_DONE
+        return complete
+
+    def _compile_body(self, tcs: P.TaskClassSpec, body: P.BodySpec):
+        """Body text → jitted function(params..., flows...) -> written flows."""
+        data_flows = [f.name for f in tcs.flows if f.access != P.FLOW_CTL]
+        written = [f.name for f in tcs.flows
+                   if f.access in (P.FLOW_WRITE, P.FLOW_RW)]
+        args = list(tcs.params) + data_flows
+        for name in args:
+            if not name.isidentifier():
+                raise P.PTGSyntaxError(f"bad identifier {name!r}")
+        src = textwrap.dedent(body.source)
+        import re as _re
+        if _re.search(r"\breturn\b", src):
+            raise P.PTGSyntaxError(
+                f"BODY of {tcs.name} must not use 'return'; written flows "
+                f"are returned automatically", body.line_no)
+        fn_src = (f"def __ptg_body__({', '.join(args)}):\n"
+                  + textwrap.indent(src if src.strip() else "pass", "    ")
+                  + f"\n    return ({', '.join(written)}{',' if written else ''})")
+        ns: Dict[str, Any] = {}
+        ns.update(self.env_base)
+        try:
+            import jax
+            import jax.numpy as jnp
+            ns.setdefault("jnp", jnp)
+            ns.setdefault("jax", jax)
+            ns.setdefault("lax", jax.lax)
+        except Exception:
+            pass
+        ns.setdefault("np", np)
+        try:
+            exec(compile(fn_src, f"<ptg-body:{tcs.name}>", "exec"), ns)  # noqa: S102
+        except SyntaxError as e:
+            raise P.PTGSyntaxError(
+                f"BODY of {tcs.name} does not compile: {e}", body.line_no) from e
+        raw = ns["__ptg_body__"]
+        import jax
+        return jax.jit(raw)
+
+    def _ptg_data_arrived(self, tc_name: str, pkey, flow_index: int,
+                          payload, wire_dtt: Optional[str] = None) -> None:
+        """A remote producer's output landed here: credit every local
+        successor it feeds, re-deriving them from the replicated program
+        (the reference's phantom-task iterate-successors,
+        remote_dep_mpi.c:861). ``wire_dtt`` names the datatype the payload
+        was reshaped to BEFORE the wire (pre-send reshape) so consumers
+        never re-reshape with the same type."""
+        pkey = tuple(pkey) if isinstance(pkey, (list, tuple)) else (pkey,)
+        with self._ptg_lock:
+            self._ptg_received[(tc_name, pkey, flow_index, wire_dtt)] = \
+                (payload, wire_dtt)
+        tc = self._classes[tc_name]
+        tcs = self.program.spec.task_class(tc_name)
+        plocals = dict(zip(tcs.params, pkey))
+        my = self.ctx.my_rank
+        ready = []
+        flow = tc.flows[flow_index]
+        for dep in flow.deps_out:
+            if getattr(dep, "wire_datatype", dep.datatype) != wire_dtt:
+                # each typed send credits exactly the successors on edges
+                # of its own wire datatype (one flow may fan out under
+                # several)
+                continue
+            if dep.cond is not None and not dep.cond(plocals):
+                continue
+            targets = dep.target_locals(plocals) if dep.target_locals else [plocals]
+            for tl in targets:
+                succ_tc = dep.task_class
+                if self.task_rank_of(succ_tc, tl) != my:
+                    continue
+                key = succ_tc.make_key(self, tl)
+                goal = (succ_tc.dependencies_goal_fn(tl)
+                        if succ_tc.dependencies_goal_fn else None)
+                if self.update_deps(succ_tc, key, 1, goal):
+                    ready.append(self.ctx.make_task(self, succ_tc, dict(tl)))
+        if ready:
+            self.ctx.schedule(ready)
+
+    def _declare_complete(self) -> None:
+        super()._declare_complete()
+        # retire the reshape-promise table and parked remote payloads: the
+        # graph is done, no consumer can request them again (the reference
+        # retires reshape promises with repo-entry refcounts)
+        with self._typed_lock:
+            self._typed_cache.clear()
+        with self._ptg_lock:
+            self._ptg_received.clear()
+
+    # ------------------------------------------------------------------ startup
+    def _enumerate(self):
+        """Yield every locals assignment in the task space, class by class
+        (the generated startup-task enumerator, jdf2c.c:3047)."""
+        for tcs in self.program.spec.task_classes:
+            tc = self._classes[tcs.name]
+            yield from ((tc, loc) for loc in self._enum_class(tc))
+
+    def _enum_class(self, tc: TaskClass):
+        ranges = tc._ptg_ranges
+        def rec(i: int, loc: Dict[str, int]):
+            if i == len(ranges):
+                yield dict(loc)
+                return
+            param, lo, hi, step = ranges[i]
+            env = self._env(loc)
+            lo_v, hi_v, st_v = int(lo(env)), int(hi(env)), int(step(env))
+            end = hi_v + 1 if st_v > 0 else hi_v - 1
+            for v in range(lo_v, end, st_v):        # inclusive, like JDF
+                loc[param] = v
+                yield from rec(i + 1, loc)
+            loc.pop(param, None)
+        yield from rec(0, {})
+
+    def _agglomerable(self, tc: TaskClass) -> bool:
+        """A class the runtime may execute as ONE fused sweep at startup:
+        statically proven independent — no flows at all (so no deps in or
+        out, no data, nothing downstream waits on any instance) and no
+        custom startup seeding. The PTG analogue of capture: when the
+        static structure proves there is nothing to schedule AROUND, the
+        per-task scheduling cycle is pure overhead (the reference pays ~0
+        for that cycle in C; we eliminate it instead)."""
+        return (not tc.flows
+                and getattr(tc, "_ptg_startup_fn", None) is None
+                # exactly one ungated body: multi-incarnation classes pick
+                # a chore per task ([evaluate] gates, device choice) — the
+                # sweep must not bypass that selection
+                and len(tc.incarnations) == 1
+                and tc.incarnations[0].evaluate is None
+                # a sweep runs on the startup thread: with worker streams
+                # the per-task path spreads instances across cores instead
+                and len(self.ctx.streams) == 1
+                and mca.get("ptg_agglomerate", True)
+                and not self.ctx.pins.enabled
+                and not self.ctx.paranoid)
+
+    def _enum_class_fast(self, tc: TaskClass):
+        """Param-value tuples via itertools.product when every range bound
+        is static (depends on globals only); None when bounds reference
+        other params (triangular spaces fall back to the dict walk)."""
+        import itertools
+        env0 = self._env({})
+        rs = []
+        for i, (param, lo, hi, step) in enumerate(tc._ptg_ranges):
+            if param != tc._ptg_spec.params[i]:
+                return None
+            try:
+                lo_v, hi_v, st_v = int(lo(env0)), int(hi(env0)), int(step(env0))
+            except Exception:  # noqa: BLE001 — bound needs an outer param
+                return None
+            rs.append(range(lo_v, hi_v + 1 if st_v > 0 else hi_v - 1, st_v))
+        return itertools.product(*rs) if rs else iter(((),))
+
+    def _run_agglomerated(self, stream, tc: TaskClass) -> int:
+        """Execute a proven-independent flowless class as one fused sweep;
+        returns the instance count (reported executed, never scheduled)."""
+        raw = tc._ptg_raw_body
+        my_rank = self.ctx.my_rank
+        distributed = self.ctx.nb_ranks > 1 and self.ctx.comm is not None
+        n = 0
+        it = None if distributed else self._enum_class_fast(tc)
+        if it is not None:
+            for vals in it:
+                raw(*vals)
+                n += 1
+        else:
+            params = tc._ptg_spec.params
+            for loc in self._enum_class(tc):
+                if distributed and tc._ptg_rank_of(loc) != my_rank:
+                    continue
+                raw(*[loc[p] for p in params])
+                n += 1
+        stream.nb_executed += n
+        return n
+
+    def _startup(self, stream, tp) -> List[Task]:
+        total = 0
+        ready: List[Task] = []
+        my_rank = self.ctx.my_rank
+        distributed = self.ctx.nb_ranks > 1 and self.ctx.comm is not None
+        agg = {tcs.name for tcs in self.program.spec.task_classes
+               if self._agglomerable(self._classes[tcs.name])}
+        self._agglomerated = 0
+        for name in agg:
+            self._agglomerated += self._run_agglomerated(
+                stream, self._classes[name])
+        for tcs in self.program.spec.task_classes:
+            if tcs.name in agg:
+                continue        # executed above, never scheduled/counted
+            tc = self._classes[tcs.name]
+            for loc in self._enum_class(tc):
+                if distributed and tc._ptg_rank_of(loc) != my_rank:
+                    continue
+                total += 1
+                if getattr(tc, "_ptg_startup_fn", None) is not None:
+                    continue    # custom startup seeds this class below
+                if tc.dependencies_goal_fn(loc) == 0:
+                    ready.append(self.ctx.make_task(self, tc, loc))
+        # user-defined startup (ref: udf.jdf startup_fn): fn(taskpool,
+        # task_class) yields the locals of this class's initial ready tasks
+        for tcs in self.program.spec.task_classes:
+            tc = self._classes[tcs.name]
+            fn = getattr(tc, "_ptg_startup_fn", None)
+            if fn is None:
+                continue
+            for loc in fn(self, tc):
+                loc = dict(loc)
+                if distributed and tc._ptg_rank_of(loc) != my_rank:
+                    continue
+                ready.append(self.ctx.make_task(self, tc, loc))
+        self.set_nb_tasks(total)
+        output.debug_verbose(2, "ptg",
+                             f"{self.name}: {total} tasks, {len(ready)} at startup")
+        return ready
+
+
+class PTGProgram:
+    """A compiled PTG program; instantiate per (globals, collections) run."""
+
+    def __init__(self, spec: P.ProgramSpec) -> None:
+        self.spec = spec
+
+    def instantiate(self, ctx: Context, globals: Optional[Dict[str, Any]] = None,
+                    collections: Optional[Dict[str, Any]] = None,
+                    name: Optional[str] = None,
+                    datatypes: Optional[Dict[str, NamedDatatype]] = None
+                    ) -> PTGTaskpool:
+        return PTGTaskpool(self, ctx, dict(globals or {}),
+                           dict(collections or {}), name,
+                           datatypes=datatypes)
+
+
+def compile_ptg(source: str, name: str = "ptg") -> PTGProgram:
+    """Compile PTG source (the parsec-ptgpp entry point)."""
+    return PTGProgram(P.parse(source, name))

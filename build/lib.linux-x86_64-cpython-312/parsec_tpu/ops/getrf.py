@@ -1,0 +1,109 @@
+"""Tiled LU factorization (dgetrf, no pivoting) DAG builder.
+
+The DPLASMA-style dgetrf of BASELINE config 5, built on the DTD frontend.
+Right-looking tile algorithm (incremental variant without pivoting — the
+reference's dplasma offers nopiv and incpiv flavors; nopiv matches well-
+conditioned/diagonally-dominant inputs, which the test generator provides):
+
+    for k:  A[k,k] = LU(A[k,k])
+            A[k,n] = L(k,k)^-1 A[k,n]          (row panel, n > k)
+            A[m,k] = A[m,k] U(k,k)^-1          (col panel, m > k)
+            A[m,n] -= A[m,k] A[k,n]            (trailing update)
+
+Tile bodies are jittable (lax.lu is TPU-lowered; triangular solves ride the
+MXU)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.matrix import TiledMatrix
+from ..dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
+
+
+def tile_getrf(a):
+    """In-tile LU without pivoting: returns packed L\\U (unit lower)."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(A, j):
+        rows = jnp.arange(A.shape[0])
+        # scale the sub-diagonal part of column j by 1/pivot
+        piv = A[j, j]
+        scaled = jnp.where(rows > j, A[:, j] / piv, A[:, j])
+        A = A.at[:, j].set(scaled)
+        # rank-1 update restricted to the trailing block
+        mask = (rows > j)[:, None] & (jnp.arange(A.shape[1]) > j)[None, :]
+        A = A - jnp.where(mask, jnp.outer(A[:, j], A[j, :]), 0.0)
+        return A, None
+
+    out, _ = jax.lax.scan(body, a, jnp.arange(a.shape[0]))
+    return out
+
+
+def tile_trsm_l(akk, akn):
+    """A[k,n] <- L(k,k)^{-1} A[k,n] (unit lower from packed LU)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.scipy.linalg.solve_triangular(
+        jnp.tril(akk, -1) + jnp.eye(akk.shape[0], dtype=akk.dtype),
+        akn, lower=True)
+
+
+def tile_trsm_u(akk, amk):
+    """A[m,k] <- A[m,k] U(k,k)^{-1}."""
+    import jax
+    import jax.numpy as jnp
+    u = jnp.triu(akk)
+    return jax.scipy.linalg.solve_triangular(u.T, amk.T, lower=True).T
+
+
+def tile_gemm_lu(amk, akn, amn):
+    """A[m,n] -= A[m,k] @ A[k,n]."""
+    import jax.numpy as jnp
+    return amn - jnp.dot(amk, akn, preferred_element_type=jnp.float32).astype(amn.dtype)
+
+
+def insert_getrf_tasks(tp: DTDTaskpool, A: TiledMatrix) -> int:
+    """Right-looking tiled LU (no pivoting). Returns task count."""
+    T = A.mt
+    assert A.mt == A.nt
+    n0 = tp.inserted
+    for k in range(T):
+        prio = (T - k) * 10000
+        tp.insert_task(tile_getrf, (tp.tile_of(A, k, k), RW | AFFINITY),
+                       priority=prio + 3000, name="GETRF")
+        for n in range(k + 1, T):
+            tp.insert_task(tile_trsm_l, (tp.tile_of(A, k, k), READ),
+                           (tp.tile_of(A, k, n), RW | AFFINITY),
+                           priority=prio + 2000, name="TRSM_L")
+        for m in range(k + 1, T):
+            tp.insert_task(tile_trsm_u, (tp.tile_of(A, k, k), READ),
+                           (tp.tile_of(A, m, k), RW | AFFINITY),
+                           priority=prio + 2000, name="TRSM_U")
+        for m in range(k + 1, T):
+            for n in range(k + 1, T):
+                tp.insert_task(tile_gemm_lu,
+                               (tp.tile_of(A, m, k), READ),
+                               (tp.tile_of(A, k, n), READ),
+                               (tp.tile_of(A, m, n), RW | AFFINITY),
+                               priority=prio, name="GEMM")
+    return tp.inserted - n0
+
+
+def getrf_flops(N: int) -> float:
+    return 2.0 * N ** 3 / 3.0
+
+
+def make_dd(n: int, seed: int = 0, dtype=np.float32) -> np.ndarray:
+    """Diagonally-dominant matrix: safe for LU without pivoting."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float64)
+    a += np.eye(n) * (np.abs(a).sum(axis=1).max() + 1.0)
+    return a.astype(dtype)
+
+
+def unpack_lu(packed: np.ndarray):
+    L = np.tril(packed, -1) + np.eye(packed.shape[0], dtype=packed.dtype)
+    U = np.triu(packed)
+    return L, U

@@ -1,0 +1,170 @@
+"""Tiled Cholesky (POTRF) kernels and DAG builder.
+
+The second headline benchmark (BASELINE.md: tiled dPOTRF). Right-looking
+tiled Cholesky — the canonical PaRSEC/DPLASMA example (the reference ships it
+as dplasma's dpotrf and exercises the same DAG shape in its DTD tests):
+
+    for k in range(T):
+        A[k,k] = POTRF(A[k,k])
+        for m > k:    A[m,k] = TRSM(A[k,k], A[m,k])
+        for m > k:    A[m,m] = SYRK(A[m,k], A[m,m])
+        for m > n > k: A[m,n] = GEMM(A[m,k], A[n,k], A[m,n])
+
+Tile bodies are jittable; XLA lowers cholesky/triangular_solve natively on
+TPU. The DAG (RAW on panels, WAW on trailing updates) is discovered by the
+DTD tile chains, exactly like the insert-task Cholesky of the reference
+(BASELINE.json config 3: "DTD Cholesky (dpotrf)").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.matrix import TiledMatrix
+from ..dsl.dtd import AFFINITY, DTDTaskpool, READ, RW
+
+
+def tile_potrf(a):
+    """Cholesky of the diagonal tile (lower)."""
+    import jax
+    import jax.numpy as jnp
+    # cholesky's internal dots have no precision arg; scope the default so
+    # f32 factorization keeps f32 accuracy on the MXU
+    with jax.default_matmul_precision("highest"):
+        return jnp.linalg.cholesky(a)
+
+
+def tile_trsm(akk, amk):
+    """A[m,k] <- A[m,k] · L(k,k)^{-T}  (right, lower, transposed)."""
+    import jax
+    import jax.numpy as jnp
+    # solve L X^T = A^T  =>  X = A L^{-T}
+    with jax.default_matmul_precision("highest"):
+        return jax.scipy.linalg.solve_triangular(akk, amk.T, lower=True).T
+
+
+def tile_syrk(amk, amm):
+    """A[m,m] <- A[m,m] - A[m,k] · A[m,k]^T."""
+    import jax.numpy as jnp
+    from .pallas_kernels import dot_precision
+    return amm - jnp.dot(amk, amk.T, precision=dot_precision(),
+                         preferred_element_type=jnp.float32).astype(amm.dtype)
+
+
+def tile_gemm_update(amk, ank, amn):
+    """A[m,n] <- A[m,n] - A[m,k] · A[n,k]^T."""
+    import jax.numpy as jnp
+    from .pallas_kernels import dot_precision
+    return amn - jnp.dot(amk, ank.T, precision=dot_precision(),
+                         preferred_element_type=jnp.float32).astype(amn.dtype)
+
+
+def insert_potrf_tasks(tp: DTDTaskpool, A: TiledMatrix) -> int:
+    """Insert the right-looking tiled Cholesky DAG (lower). Returns task count.
+
+    Priorities follow the critical path (panel first), the standard trick the
+    reference relies on priority-aware schedulers for.
+    """
+    T = A.mt
+    assert A.mt == A.nt, "POTRF needs a square tile grid"
+    n0 = tp.inserted
+    for k in range(T):
+        prio = (T - k) * 10000
+        tp.insert_task(tile_potrf, (tp.tile_of(A, k, k), RW | AFFINITY),
+                       priority=prio + 3000, name="POTRF")
+        for m in range(k + 1, T):
+            tp.insert_task(tile_trsm,
+                           (tp.tile_of(A, k, k), READ),
+                           (tp.tile_of(A, m, k), RW | AFFINITY),
+                           priority=prio + 2000, name="TRSM")
+        for m in range(k + 1, T):
+            tp.insert_task(tile_syrk,
+                           (tp.tile_of(A, m, k), READ),
+                           (tp.tile_of(A, m, m), RW | AFFINITY),
+                           priority=prio + 1000, name="SYRK")
+            for n in range(k + 1, m):
+                tp.insert_task(tile_gemm_update,
+                               (tp.tile_of(A, m, k), READ),
+                               (tp.tile_of(A, n, k), READ),
+                               (tp.tile_of(A, m, n), RW | AFFINITY),
+                               priority=prio, name="GEMM")
+    return tp.inserted - n0
+
+
+def potrf_flops(N: int) -> float:
+    """N^3/3 (+ lower order), the standard dpotrf count."""
+    return N ** 3 / 3.0 + N ** 2 / 2.0
+
+
+def make_spd(n: int, seed: int = 0, dtype=np.float32) -> np.ndarray:
+    """A well-conditioned SPD matrix for tests/benchmarks."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float64) / np.sqrt(n)
+    spd = a @ a.T + np.eye(n) * n * 0.05
+    return spd.astype(dtype)
+
+
+# --------------------------------------------------------------- SPD solve
+
+def tile_trsv_l(lkk, bk):
+    """B[k] <- L(k,k)^{-1} B[k] (forward substitution step)."""
+    import jax
+    with jax.default_matmul_precision("highest"):
+        return jax.scipy.linalg.solve_triangular(lkk, bk, lower=True)
+
+
+def tile_trsv_lt(lkk, bk):
+    """B[k] <- L(k,k)^{-T} B[k] (backward substitution step)."""
+    import jax
+    with jax.default_matmul_precision("highest"):
+        return jax.scipy.linalg.solve_triangular(lkk, bk, lower=True,
+                                                 trans=1)
+
+
+def tile_gemv_sub(lmk, yk, bm):
+    """B[m] <- B[m] - L(m,k) Y[k]."""
+    import jax.numpy as jnp
+    from .pallas_kernels import dot_precision
+    return bm - jnp.dot(lmk, yk, precision=dot_precision(),
+                        preferred_element_type=jnp.float32).astype(bm.dtype)
+
+
+def tile_gemv_sub_t(lkm, xk, ym):
+    """Y[m] <- Y[m] - L(k,m)^T X[k]."""
+    import jax.numpy as jnp
+    from .pallas_kernels import dot_precision
+    return ym - jnp.dot(lkm.T, xk, precision=dot_precision(),
+                        preferred_element_type=jnp.float32).astype(ym.dtype)
+
+
+def insert_posv_tasks(tp: DTDTaskpool, A: TiledMatrix,
+                      B: TiledMatrix) -> int:
+    """Solve A X = B for SPD A (the DPLASMA dposv shape): Cholesky
+    factorization followed by tiled forward and backward substitution, one
+    taskpool — the solves chain onto the factorization through the tile
+    dependencies, so panels start solving while trailing updates still run.
+    B is a (T x 1)-tile right-hand-side collection, overwritten with X.
+    Works under both execution modes (scheduler and capture)."""
+    T = A.mt
+    assert A.mt == A.nt and B.mt == T and B.nt == 1
+    n0 = tp.inserted
+    insert_potrf_tasks(tp, A)
+    # forward: L Y = B
+    for k in range(T):
+        tp.insert_task(tile_trsv_l, (tp.tile_of(A, k, k), READ),
+                       (tp.tile_of(B, k, 0), RW | AFFINITY), name="TRSV_L")
+        for m in range(k + 1, T):
+            tp.insert_task(tile_gemv_sub, (tp.tile_of(A, m, k), READ),
+                           (tp.tile_of(B, k, 0), READ),
+                           (tp.tile_of(B, m, 0), RW | AFFINITY),
+                           name="GEMV_SUB")
+    # backward: L^T X = Y
+    for k in reversed(range(T)):
+        tp.insert_task(tile_trsv_lt, (tp.tile_of(A, k, k), READ),
+                       (tp.tile_of(B, k, 0), RW | AFFINITY), name="TRSV_LT")
+        for m in range(k):
+            tp.insert_task(tile_gemv_sub_t, (tp.tile_of(A, k, m), READ),
+                           (tp.tile_of(B, k, 0), READ),
+                           (tp.tile_of(B, m, 0), RW | AFFINITY),
+                           name="GEMV_SUB_T")
+    return tp.inserted - n0

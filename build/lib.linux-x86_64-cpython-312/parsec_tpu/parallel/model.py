@@ -1,0 +1,570 @@
+"""GPT-class causal language model: the flagship model family, assembled
+from the parallel building blocks.
+
+The reference is a task runtime, not a model zoo — this module is the
+"what you train WITH the framework" layer (SURVEY §2.8 beyond-reference
+rows): a complete decoder-only LM (learned token + position embeddings,
+N pre-LN transformer blocks, final LN, tied LM head) with
+
+* :func:`lm_apply` / :func:`lm_loss` — pure jax forward + token
+  cross-entropy, pluggable attention core (dense, Pallas flash, ring);
+* :func:`make_lm_train_step` — ONE compiled GSPMD step over a (dp, tp)
+  mesh: batch over ``dp``; Megatron column/row-parallel block weights and
+  vocab-parallel embedding/head over ``tp``. The sharding annotations are
+  the whole distribution story — XLA inserts the dp grad all-reduces and
+  the tp activation collectives (scaling-book recipe, like
+  :func:`parsec_tpu.parallel.transformer.make_train_step`).
+
+Sequence parallelism for long contexts: pass
+``attention=ring_core(mesh)`` (see :func:`ring_attention_core`) and shard
+the tokens' sequence axis instead — the blocks are token-local outside
+attention, so the same forward runs under either layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import numpy as np
+
+from .transformer import (block_apply, init_block_params, _ln, _param_spec,
+                          _placers, ring_attention_core)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Decoder-only LM hyperparameters (frozen: usable as a cache key)."""
+    vocab_size: int = 256
+    d_model: int = 128
+    d_ff: int = 512
+    n_heads: int = 8
+    n_layers: int = 2
+    max_seq: int = 256
+
+
+def init_lm_params(seed: int, cfg: ModelConfig) -> dict:
+    """Embeddings + per-block params + final LN. The LM head is TIED to
+    the token embedding (logits = h @ embed.T), the standard
+    weight-sharing that also halves the largest tensor."""
+    rng = np.random.default_rng(seed)
+    f32 = np.float32
+    p = {
+        "embed": (rng.standard_normal((cfg.vocab_size, cfg.d_model)) *
+                  0.02).astype(f32),
+        "pos": (rng.standard_normal((cfg.max_seq, cfg.d_model)) *
+                0.02).astype(f32),
+        "lnf_g": np.ones(cfg.d_model, f32),
+        "lnf_b": np.zeros(cfg.d_model, f32),
+        "blocks": [init_block_params(seed + 1 + i, cfg.d_model, cfg.d_ff,
+                                     cfg.n_heads)
+                   for i in range(cfg.n_layers)],
+    }
+    return p
+
+
+def lm_apply(params: dict, tokens, causal: bool = True, attention=None,
+             remat: bool = False, compute_dtype=None):
+    """tokens (B, S) int32 -> logits (B, S, V).
+
+    TPU memory/throughput knobs (the brief's HBM levers):
+
+    * ``remat=True`` wraps each block in ``jax.checkpoint`` — activations
+      are recomputed in the backward pass instead of stored, trading
+      FLOPs for HBM (deep models / long sequences).
+    * ``compute_dtype=jnp.bfloat16`` runs the blocks in bf16 (the
+      MXU-native dtype) with f32 master params; the logits and loss stay
+      f32 (``preferred_element_type`` accumulation on the tied head).
+    """
+    import jax
+    import jax.numpy as jnp
+    S = tokens.shape[1]
+    if S > params["pos"].shape[0]:
+        raise ValueError(f"sequence length {S} exceeds the model's "
+                         f"max_seq {params['pos'].shape[0]}")
+    blocks = params["blocks"]
+    h = params["embed"][tokens] + params["pos"][:S][None, :, :]
+    if compute_dtype is not None:
+        cast = (lambda t: t.astype(compute_dtype)
+                if jnp.issubdtype(t.dtype, jnp.floating) else t)
+        h = cast(h)
+        blocks = jax.tree_util.tree_map(cast, blocks)
+    step = functools.partial(block_apply, causal=causal,
+                             attention=attention)
+    if remat:
+        step = jax.checkpoint(step)
+    for bp in blocks:
+        h = step(bp, h)
+    h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    return jnp.einsum("bsd,vd->bsv", h, params["embed"],
+                      preferred_element_type=jnp.float32)
+
+
+# ----------------------------------------------------------- MoE-LM family
+
+def init_lm_moe_params(seed: int, cfg: ModelConfig, n_experts: int) -> dict:
+    """Switch/Mixtral-class variant: every block's position-wise MLP is
+    replaced by a router + ``n_experts`` expert MLPs (hidden ``cfg.d_ff``).
+    Attention/embedding/LN params are identical to :func:`init_lm_params`."""
+    from .moe import init_moe_params
+    p = init_lm_params(seed, cfg)
+    for i, bp in enumerate(p["blocks"]):
+        for k in ("w1", "b1", "w2", "b2"):
+            bp.pop(k)
+        bp["moe"] = init_moe_params(seed + 101 + i, n_experts,
+                                    cfg.d_model, cfg.d_ff)
+    return p
+
+
+def lm_moe_apply(params: dict, tokens, causal: bool = True, k: int = 2,
+                 mesh=None, capacity_factor: Optional[float] = None,
+                 return_aux: bool = False, remat: bool = False):
+    """MoE-LM forward: logits (B, S, V), with each block's FFN routed
+    through its top-``k`` experts.
+
+    ``mesh=None`` computes the routed FFN densely (every token through its
+    selected experts, no parallelism — the truth). With an ``ep`` mesh the
+    experts are SHARDED over it and dispatch/combine ride ``all_to_all``
+    (:func:`parsec_tpu.parallel.moe.moe_forward`); with no-drop capacity
+    (the default) both paths agree, and the whole forward jits and
+    differentiates (moe_forward skips host placement under a trace).
+    ``return_aux=True`` adds ``{"aux_loss", "dropped"}`` — the mean Switch
+    load-balancing loss over blocks (add ``lambda*aux`` to the training
+    objective) and the total overflow drops (always 0 on the dense
+    path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .moe import _topk_gates, dense_reference, moe_forward
+
+    tokens = jnp.asarray(tokens)
+    B, S = tokens.shape
+    if S > params["pos"].shape[0]:
+        raise ValueError(f"sequence length {S} exceeds the model's "
+                         f"max_seq {params['pos'].shape[0]}")
+    if remat and return_aux:
+        # the aux accumulator is a host-side closure; a rematerialized
+        # backward would replay the appends and double-count it
+        raise ValueError("remat=True is incompatible with return_aux=True "
+                         "(compute the aux loss in a separate un-rematted "
+                         "forward)")
+    x = params["embed"][tokens] + params["pos"][:S][None]
+    aux_acc, drop_acc = [], []
+    for bp in params["blocks"]:
+        mp = bp["moe"]
+
+        def ffn(h, mp=mp):
+            h2 = h.reshape(B * S, -1)
+            if mesh is None:
+                if return_aux:
+                    # Switch aux loss from the EXACT routed activation
+                    # (the mesh path reuses moe_forward's own computation)
+                    E = mp["w1"].shape[0]
+                    probs = jax.nn.softmax(h2 @ mp["router"], axis=-1)
+                    _, eid = _topk_gates(probs, k)
+                    f = jnp.mean(jax.nn.one_hot(eid[:, 0], E,
+                                                dtype=jnp.float32), axis=0)
+                    aux_acc.append(E * jnp.sum(
+                        f * probs.astype(jnp.float32).mean(0)))
+                    drop_acc.append(jnp.float32(0.0))   # no-drop by def
+                out = dense_reference(mp, h2, k=k)
+            elif return_aux:
+                out, a = moe_forward(mp, h2, mesh=mesh, k=k,
+                                     capacity_factor=capacity_factor,
+                                     return_aux=True)
+                aux_acc.append(a["aux_loss"])
+                drop_acc.append(a["dropped"])
+            else:
+                out = moe_forward(mp, h2, mesh=mesh, k=k,
+                                  capacity_factor=capacity_factor)
+            return jnp.asarray(out).reshape(B, S, -1)
+
+        blk = (jax.checkpoint(functools.partial(
+                   block_apply, causal=causal, ffn=ffn))
+               if remat else
+               functools.partial(block_apply, causal=causal, ffn=ffn))
+        x = blk(bp, x)
+    h = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits = jnp.einsum("bsd,vd->bsv", h, params["embed"],
+                        preferred_element_type=jnp.float32)
+    if return_aux:
+        return logits, {"aux_loss": sum(aux_acc) / len(aux_acc),
+                        "dropped": sum(drop_acc)}
+    return logits
+
+
+def make_lm_moe_train_step(mesh=None, k: int = 2, lr: float = 1e-2,
+                           aux_weight: float = 0.01, causal: bool = True):
+    """A jitted SGD step for the MoE-LM: token cross-entropy plus
+    ``aux_weight`` x the Switch load-balancing loss, gradients through the
+    expert dispatch (the ``ep`` mesh's all_to_all when ``mesh`` is given,
+    the dense routed truth otherwise). Returns
+    ``step(params, tokens, targets) -> (params, loss)``; losses from both
+    paths agree under no-drop capacity."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(p, tokens, targets):
+        logits, aux = lm_moe_apply(p, tokens, causal=causal, k=k,
+                                   mesh=mesh, return_aux=True)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None],
+                                   axis=-1).squeeze(-1)
+        return jnp.mean(logz - gold) + aux_weight * aux["aux_loss"]
+
+    @jax.jit
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                        params, grads)
+        return params, loss
+
+    return step
+
+
+def lm_loss(params: dict, tokens, targets, causal: bool = True,
+            attention=None, remat: bool = False, compute_dtype=None):
+    """Mean next-token cross-entropy; ``targets`` (B, S) int32."""
+    import jax
+    import jax.numpy as jnp
+    logits = lm_apply(params, tokens, causal=causal, attention=attention,
+                      remat=remat, compute_dtype=compute_dtype)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None],
+                               axis=-1).squeeze(-1)
+    return jnp.mean(logz - gold)
+
+
+
+
+def _decode_block(bp, x, ck, cv, pos, scale, ffn=None):
+    """One transformer block for ONE new token at position ``pos`` against
+    KV caches (B, H, S, dh): the TPU-idiomatic incremental step — static
+    shapes, `dynamic_update_slice` cache writes, position-masked scores.
+    ``ffn`` swaps the position-wise MLP exactly like ``block_apply``'s
+    hook (the MoE-LM passes its routed closure to BOTH)."""
+    import jax
+    import jax.numpy as jnp
+    h = _ln(x, bp["ln1_g"], bp["ln1_b"])                     # (B, 1, D)
+    qkv = jnp.einsum("bsd,chdk->cbhsk", h, bp["wqkv"])       # (3,B,H,1,dh)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, pos, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, pos, 0))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * scale         # (B,H,1,S)
+    k_pos = jnp.arange(ck.shape[2])
+    s = jnp.where(k_pos[None, None, None, :] <= pos, s, -jnp.inf)
+    a = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", a, cv)
+    x = x + jnp.einsum("bhsd,hdo->bso", o, bp["wo"])
+    h = _ln(x, bp["ln2_g"], bp["ln2_b"])
+    if ffn is not None:
+        return x + ffn(h), ck, cv
+    h = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
+    return x + h @ bp["w2"] + bp["b2"], ck, cv
+
+
+# bounded: every distinct (prompt_len, n_tokens, ...) pins a compiled
+# program incl. its device buffers, so varied-length generation must
+# recompile past the bound instead of leaking executables without limit
+@functools.lru_cache(maxsize=16)
+def _compiled_generate(n_layers: int, prompt_len: int, n_tokens: int,
+                       greedy: bool, temperature: float,
+                       moe_k: Optional[int] = None):
+    import jax
+    import jax.numpy as jnp
+
+    def _ffn_of(bp):
+        if moe_k is None:
+            return None
+        from .moe import dense_reference
+
+        def ffn(h, bp=bp):
+            flat = dense_reference(bp["moe"], h.reshape(-1, h.shape[-1]),
+                                   k=moe_k)
+            return flat.reshape(h.shape)
+        return ffn
+
+    def generate(params, prompt, key):
+        B = prompt.shape[0]
+        dh = params["blocks"][0]["wqkv"].shape[3]
+        S = prompt_len + n_tokens        # caches sized to what's generated
+        scale = 1.0 / float(np.sqrt(dh))
+
+        # ---- prefill: whole prompt in one pass through block_apply (the
+        # ONE source of full-forward block math), seeding the KV caches
+        x = params["embed"][prompt] + params["pos"][:prompt_len][None]
+        cks, cvs = [], []
+        for bp in params["blocks"]:
+            x, k, v = block_apply(bp, x, causal=True, return_kv=True,
+                                  ffn=_ffn_of(bp))
+            pad = [(0, 0), (0, 0), (0, S - prompt_len), (0, 0)]
+            cks.append(jnp.pad(k, pad))
+            cvs.append(jnp.pad(v, pad))
+        h = _ln(x, params["lnf_g"], params["lnf_b"])
+        logits = jnp.einsum("bd,vd->bv", h[:, -1], params["embed"])
+
+        def sample(logits, key):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+            key_t = jax.random.fold_in(key, 0)
+            return jax.random.categorical(
+                key_t, logits / temperature, axis=-1).astype(prompt.dtype)
+
+        tok0 = sample(logits, key)
+
+        def step(carry, i):
+            tok, cks, cvs, key = carry
+            pos = prompt_len + i
+            x = params["embed"][tok][:, None, :] \
+                + jax.lax.dynamic_slice(params["pos"], (pos, 0),
+                                        (1, params["pos"].shape[1]))[None]
+            new_k, new_v = [], []
+            for li, bp in enumerate(params["blocks"]):
+                x, ck, cv = _decode_block(bp, x, cks[li], cvs[li], pos,
+                                          scale, ffn=_ffn_of(bp))
+                new_k.append(ck)
+                new_v.append(cv)
+            h = _ln(x, params["lnf_g"], params["lnf_b"])
+            logits = jnp.einsum("bd,vd->bv", h[:, 0], params["embed"])
+            key = jax.random.fold_in(key, i + 1)
+            nxt = sample(logits, key)
+            return (nxt, new_k, new_v, key), tok
+
+        (last, _, _, _), toks = jax.lax.scan(
+            step, (tok0, cks, cvs, key), jnp.arange(n_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)                     # (B, n-1)
+        return jnp.concatenate([prompt, toks, last[:, None]], axis=1)
+
+    return jax.jit(generate)
+
+
+def lm_generate(params: dict, prompt, n_tokens: int, greedy: bool = True,
+                temperature: float = 1.0, key=None,
+                moe_k: Optional[int] = None):
+    """Autoregressive generation with per-layer KV caches: ONE compiled
+    program — full-prompt prefill seeds the caches, then a ``lax.scan``
+    decode loop (static shapes, `dynamic_update_slice` cache writes).
+    ``prompt`` (B, P) int32; returns (B, P + n_tokens). Greedy by default;
+    ``greedy=False`` samples at ``temperature`` using ``key``
+    (``temperature <= 0`` means greedy). MoE-LM params (blocks carrying a
+    ``moe`` sub-dict) decode with their FFNs routed top-``moe_k``
+    (defaults to 2 when detected)."""
+    import jax
+    prompt = np.asarray(prompt) if not hasattr(prompt, "dtype") else prompt
+    P = prompt.shape[1]
+    if n_tokens <= 0:
+        return prompt
+    if temperature <= 0:
+        greedy = True
+    if P + n_tokens > params["pos"].shape[0]:
+        raise ValueError(
+            f"prompt ({P}) + n_tokens ({n_tokens}) exceeds max_seq "
+            f"{params['pos'].shape[0]}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if moe_k is None and "moe" in params["blocks"][0]:
+        moe_k = 2
+    fn = _compiled_generate(len(params["blocks"]), int(P), int(n_tokens),
+                            bool(greedy),
+                            1.0 if greedy else float(temperature),
+                            None if moe_k is None else int(moe_k))
+    return fn(params, prompt, key)
+
+
+@functools.lru_cache(maxsize=None)
+def _lm_stage_fn(per: int, causal: bool):
+    """A STABLE stage function per (layers-per-stage, causal) — it keys
+    the pipeline's compiled-program cache, so it must not be a fresh
+    closure per call."""
+    def stage_fn(sp, act):
+        for i in range(per):
+            act = block_apply({k: v[i] for k, v in sp.items()}, act,
+                              causal=causal)
+        return act
+    return stage_fn
+
+
+def lm_pp_forward(params: dict, tokens, mesh=None,
+                  n_micro: Optional[int] = None, causal: bool = True):
+    """Pipeline-parallel LM forward: the blocks split into P contiguous
+    stage groups (device i owns layers [i·L/P, (i+1)·L/P)), microbatches
+    of the batch stream through the GPipe schedule
+    (:func:`parsec_tpu.parallel.pipeline.pipeline_forward_stages`);
+    embedding and the tied head run replicated outside the pipe.
+    ``tokens`` (B, S) with B divisible by ``n_micro``; returns logits
+    (B, S, V) matching :func:`lm_apply`."""
+    import jax
+    import jax.numpy as jnp
+    from .pipeline import make_pp_mesh, pipeline_forward_stages
+
+    mesh = mesh if mesh is not None else make_pp_mesh()
+    nP = mesh.devices.size
+    L = len(params["blocks"])
+    if L % nP:
+        raise ValueError(f"{L} layers do not split over {nP} stages")
+    per = L // nP
+    B, S = tokens.shape
+    if S > params["pos"].shape[0]:
+        raise ValueError(f"sequence length {S} exceeds the model's "
+                         f"max_seq {params['pos'].shape[0]}")
+    m = int(n_micro) if n_micro is not None else nP
+    if B % m:
+        raise ValueError(f"batch {B} not divisible by n_micro {m}")
+
+    b0 = params["blocks"][0]
+    stage_params = {
+        k: jnp.stack([jnp.stack([params["blocks"][s * per + i][k]
+                                 for i in range(per)])
+                      for s in range(nP)])
+        for k in b0
+    }                                   # every leaf: (P, per, ...)
+    stage_fn = _lm_stage_fn(per, causal)
+
+    x = params["embed"][tokens] + params["pos"][:S][None]
+    xs = x.reshape(m, B // m, S, x.shape[-1])
+    # replicate_out=False: at LM scale the (B, S, D) activations stay
+    # resident on the last stage instead of riding a psum to every stage;
+    # the head below reads them where they were produced
+    out = pipeline_forward_stages(stage_params, xs, stage_fn, mesh=mesh,
+                                  n_micro=m, replicate_out=False)
+    h = _ln(out.reshape(B, S, -1), params["lnf_g"], params["lnf_b"])
+    return jnp.einsum("bsd,vd->bsv", h, params["embed"],
+                      preferred_element_type=jnp.float32)
+
+
+def _lm_param_spec(mesh, dp: str, tp: str, n_layers: int):
+    """Vocab-parallel embedding/head over ``tp``; Megatron block specs."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = {
+        "embed": NamedSharding(mesh, P(tp, None)),   # vocab-parallel
+        "pos": NamedSharding(mesh, P()),
+        "lnf_g": NamedSharding(mesh, P()),
+        "lnf_b": NamedSharding(mesh, P()),
+        "blocks": [_param_spec(mesh, dp, tp) for _ in range(n_layers)],
+    }
+    return spec
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_lm_step(mesh, dp: str, tp: str, n_layers: int, lr: float,
+                      causal: bool):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pspec = _lm_param_spec(mesh, dp, tp, n_layers)
+    tsh = NamedSharding(mesh, P(dp, None))           # tokens (B, S)
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, tokens, targets, causal=causal))(params)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        return new_params, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(pspec, tsh, tsh),
+        out_shardings=(pspec, NamedSharding(mesh, P())),
+    ), pspec, tsh
+
+
+def make_lm_train_step(mesh, dp: str = "dp", tp: str = "tp",
+                       lr: float = 1e-2, causal: bool = True,
+                       n_layers: Optional[int] = None, params: dict = None):
+    """A jitted SGD LM training step over the (dp, tp) mesh.
+
+    Returns ``(step, place_params, place_batch)``; ``n_layers`` is taken
+    from ``params`` when given. For a real optimizer (Adam, schedules,
+    clipping) use :func:`make_lm_opt_train_step`. Usage::
+
+        cfg = ModelConfig(n_layers=4)
+        params = init_lm_params(0, cfg)
+        step, place_p, place_t = make_lm_train_step(mesh, params=params)
+        params = place_p(params)
+        params, loss = step(params, place_t(tokens), place_t(targets))
+    """
+    if n_layers is None:
+        if params is None:
+            raise ValueError("pass n_layers= or params=")
+        n_layers = len(params["blocks"])
+    fn, pspec, tsh = _compiled_lm_step(mesh, dp, tp, int(n_layers),
+                                       float(lr), causal)
+    return (fn,) + _placers(pspec, tsh)
+
+
+def _state_spec_like(mesh, pspec, params, state):
+    """Shardings for an optimizer-state pytree: optax moment trees MIRROR
+    the param tree, so a state leaf whose tree path ends with a
+    parameter's full path (and matches its shape) adopts that parameter's
+    sharding — Adam's mu/nu land distributed exactly like their params.
+    Everything else (counters, scalars) replicates. Path matching (not
+    shape matching) keeps equal-shaped params with different specs apart
+    (e.g. vocab-parallel ``embed`` vs replicated ``pos`` when
+    vocab_size == max_seq)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    by_path = {}
+    for (path, leaf), spec in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_leaves(pspec)):
+        by_path[tuple(map(str, path))] = (tuple(np.shape(leaf)), spec)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    out = []
+    for path, leaf in flat:
+        keys = tuple(map(str, path))
+        spec = rep
+        for i in range(len(keys)):
+            hit = by_path.get(keys[i:])
+            if hit is not None and hit[0] == tuple(np.shape(leaf)):
+                spec = hit[1]
+                break
+        out.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def make_lm_opt_train_step(mesh, tx, params: dict, dp: str = "dp",
+                           tp: str = "tp", causal: bool = True,
+                           remat: bool = False, compute_dtype=None):
+    """An optax-powered LM training step over the (dp, tp) mesh.
+
+    ``tx`` is any ``optax.GradientTransformation`` (e.g.
+    ``optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(sched))``).
+    Optimizer moments are sharded LIKE the parameters they mirror (see
+    :func:`_state_spec_like`). ``remat``/``compute_dtype`` are the HBM
+    levers of :func:`lm_apply` (activation rematerialization; bf16
+    compute with f32 master params — grads arrive f32 via the cast's
+    transpose, so any optax transform composes unchanged). Returns
+    ``(step, opt_state, place_params, place_batch)``::
+
+        step, opt_state, place_p, place_t = make_lm_opt_train_step(
+            mesh, optax.adamw(3e-4), params)
+        params = place_p(params)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_layers = len(params["blocks"])
+    pspec = _lm_param_spec(mesh, dp, tp, n_layers)
+    tsh = NamedSharding(mesh, P(dp, None))
+    opt_state = tx.init(params)
+    ospec = _state_spec_like(mesh, pspec, params, opt_state)
+    opt_state = jax.tree_util.tree_map(jax.device_put, opt_state, ospec)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, tokens, targets, causal=causal,
+                              remat=remat,
+                              compute_dtype=compute_dtype))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    fn = jax.jit(
+        step,
+        in_shardings=(pspec, ospec, tsh, tsh),
+        out_shardings=(pspec, ospec, NamedSharding(mesh, P())),
+    )
+    return (fn, opt_state) + _placers(pspec, tsh)

@@ -1,0 +1,271 @@
+"""Flagship model family: a transformer block trained under real
+data-parallel × tensor-parallel shardings.
+
+The scaling-book recipe end to end: pick a 2D mesh ``(dp, tp)``, annotate
+the shardings — batch over ``dp``, attention heads and the MLP hidden
+dimension over ``tp`` (the Megatron split: column-parallel W_qkv/W1,
+row-parallel W_o/W2) — and let GSPMD insert every collective (grad
+all-reduces over ``dp``, activation reduce-scatters over ``tp``). Sequence
+parallelism for long contexts is the sibling module
+(:mod:`parsec_tpu.parallel.ring_attention`); this one is the training-step
+core the driver's ``dryrun_multichip`` jits over the full device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def init_block_params(seed: int, d_model: int, d_ff: int, n_heads: int,
+                      dtype=np.float32) -> Dict[str, np.ndarray]:
+    """LN + multi-head attention + 2-layer MLP, Xavier-ish init.
+
+    Head-major layouts so the tensor-parallel axis is leading:
+    ``wqkv``: (3, H, D, d_head), ``wo``: (H, d_head, D),
+    ``w1``: (D, F), ``w2``: (F, D).
+    """
+    assert d_model % n_heads == 0
+    dh = d_model // n_heads
+    rng = np.random.default_rng(seed)
+
+    def glorot(*shape, fan_in, fan_out):
+        s = np.sqrt(2.0 / (fan_in + fan_out))
+        return (rng.standard_normal(shape) * s).astype(dtype)
+
+    return {
+        "ln1_g": np.ones((d_model,), dtype), "ln1_b": np.zeros((d_model,), dtype),
+        "ln2_g": np.ones((d_model,), dtype), "ln2_b": np.zeros((d_model,), dtype),
+        "wqkv": glorot(3, n_heads, d_model, dh, fan_in=d_model, fan_out=d_model),
+        "wo": glorot(n_heads, dh, d_model, fan_in=d_model, fan_out=d_model),
+        "w1": glorot(d_model, d_ff, fan_in=d_model, fan_out=d_ff),
+        "b1": np.zeros((d_ff,), dtype),
+        "w2": glorot(d_ff, d_model, fan_in=d_ff, fan_out=d_model),
+        "b2": np.zeros((d_model,), dtype),
+    }
+
+
+def _ln(x, g, b, eps=1e-5):
+    import jax.numpy as jnp
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+
+def _dense_attention_core(q, k, v, causal: bool, scale: float):
+    import jax
+    import jax.numpy as jnp
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    a = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", a, v)
+
+
+def flash_attention_core(q, k, v, causal: bool, scale: float):
+    """Drop-in ``attention=`` core backed by the fused Pallas kernel
+    (:func:`parsec_tpu.ops.pallas_kernels.flash_attention`): scores and
+    softmax stats stay in VMEM instead of materializing the S x S matrix.
+    Best on single-chip / data-parallel layouts where the sequence axis is
+    unsharded; under GSPMD head-sharding wrap it in shard_map first."""
+    from ..ops.pallas_kernels import flash_attention
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+def block_apply(params, x, causal: bool = True, attention=None,
+                return_kv: bool = False, ffn=None):
+    """One pre-LN transformer block: x -> x + MHA(LN(x)) -> + MLP(LN(.)).
+
+    ``x``: (batch, seq, d_model). Pure jax math — the sharding story is
+    entirely in the jit annotations of :func:`make_train_step`.
+    ``attention(q, k, v, causal, scale)`` swaps the attention core (the
+    sequence-parallel variant passes the ring). ``ffn(h) -> h`` swaps the
+    position-wise MLP (the MoE-LM routes it through experts) — the
+    residual add stays here. ``return_kv=True`` additionally returns this
+    block's (k, v) — the KV-cache prefill seed
+    (:func:`parsec_tpu.parallel.model.lm_generate`) — so generation shares
+    THIS function's math rather than re-implementing it."""
+    import jax
+    import jax.numpy as jnp
+    dh = params["wqkv"].shape[3]
+    attn = attention if attention is not None else _dense_attention_core
+
+    h = _ln(x, params["ln1_g"], params["ln1_b"])
+    qkv = jnp.einsum("bsd,chdk->cbhsk", h, params["wqkv"])   # (3,B,H,S,dh)
+    ctx = attn(qkv[0], qkv[1], qkv[2], causal, 1.0 / float(np.sqrt(dh)))
+    x = x + jnp.einsum("bhsd,hdo->bso", ctx, params["wo"])
+
+    h = _ln(x, params["ln2_g"], params["ln2_b"])
+    if ffn is not None:
+        out = x + ffn(h)
+    else:
+        h = jax.nn.gelu(h @ params["w1"] + params["b1"])
+        out = x + h @ params["w2"] + params["b2"]
+    if return_kv:
+        return out, qkv[1], qkv[2]
+    return out
+
+
+def _param_spec(mesh, dp: str, tp: str):
+    """Megatron placement: heads/ff over ``tp``, everything small
+    replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    specs = {
+        "ln1_g": P(), "ln1_b": P(), "ln2_g": P(), "ln2_b": P(),
+        "wqkv": P(None, tp, None, None),   # column-parallel (heads)
+        "wo": P(tp, None, None),           # row-parallel
+        "w1": P(None, tp),                 # column-parallel (ff)
+        "b1": P(tp),
+        "w2": P(tp, None),                 # row-parallel
+        "b2": P(),
+    }
+    return {k: NamedSharding(mesh, v) for k, v in specs.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_step(mesh, dp: str, tp: str, lr: float, causal: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pspec = _param_spec(mesh, dp, tp)
+    xsh = NamedSharding(mesh, P(dp, None, None))
+
+    def step(params, x, y):
+        def loss_fn(p):
+            out = block_apply(p, x, causal=causal)
+            return jnp.mean((out - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        return new_params, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(pspec, xsh, xsh),
+        out_shardings=(pspec, NamedSharding(mesh, P())),
+    ), pspec, xsh
+
+
+def _placers(pspec, xsh):
+    """(place_params, place_batch) pair for a (param-spec-tree, batch
+    sharding): the one placement idiom every make_*_train_step shares."""
+    import jax
+
+    def place_params(params):
+        return jax.tree_util.tree_map(jax.device_put, params, pspec)
+
+    def place_batch(x):
+        return jax.device_put(x, xsh)
+
+    return place_params, place_batch
+
+
+def make_train_step(mesh, dp: str = "dp", tp: str = "tp",
+                    lr: float = 1e-2, causal: bool = True):
+    """A jitted SGD training step over the (dp, tp) mesh.
+
+    Returns ``(step, place_params, place_batch)``: call
+    ``params = place_params(params)`` / ``x = place_batch(x)`` once, then
+    ``params, loss = step(params, x, y)`` per iteration. GSPMD inserts the
+    dp grad all-reduces and tp activation collectives from the sharding
+    annotations alone.
+    """
+    fn, pspec, xsh = _compiled_step(mesh, dp, tp, float(lr), causal)
+    return (fn,) + _placers(pspec, xsh)
+
+
+def ring_attention_core(mesh):
+    """An ``attention=`` core running ring attention over ``mesh`` (the
+    long-context layout: sequence axis sharded, K/V rotating over ICI)."""
+    from .ring_attention import ring_attention
+
+    def core(q, k, v, causal, scale):
+        return ring_attention(q, k, v, mesh=mesh, causal=causal,
+                              scale=scale)
+    return core
+
+
+def block_apply_sp(params, x, mesh, causal: bool = True):
+    """The same pre-LN block with the SEQUENCE axis sharded over ``mesh``:
+    attention runs as ring attention (ppermute K/V rotation, online
+    softmax — :mod:`parsec_tpu.parallel.ring_attention`), the LN/MLP parts
+    are token-local so GSPMD keeps them sharded for free. Fully
+    differentiable: the ring's transpose is the reverse ring."""
+    return block_apply(params, x, causal=causal,
+                       attention=ring_attention_core(mesh))
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_sp_step(mesh, lr: float, causal: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert len(mesh.axis_names) == 1, \
+        f"sequence-parallel training needs a 1D mesh (got axes " \
+        f"{mesh.axis_names}); use make_1d_mesh/_seq_mesh"
+    axis = mesh.axis_names[0]
+    psp = NamedSharding(mesh, P())       # params replicated (pytree prefix)
+    xsh = NamedSharding(mesh, P(None, axis, None))   # seq sharded
+
+    def step(params, x, y):
+        def loss_fn(p):
+            out = block_apply_sp(p, x, mesh, causal=causal)
+            return jnp.mean((out - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        return new_params, loss
+
+    return jax.jit(step, in_shardings=(psp, xsh, xsh),
+                   out_shardings=(psp, NamedSharding(mesh, P()))), \
+        psp, xsh
+
+
+def make_sp_train_step(mesh, lr: float = 1e-2, causal: bool = True):
+    """Long-context training: the sequence axis sharded over the mesh,
+    attention via the ring — per-chip memory O(S/P · S/P), no S×S
+    anywhere, gradients riding the reverse ring. Same return shape as
+    :func:`make_train_step`."""
+    import jax
+    fn, psp, xsh = _compiled_sp_step(mesh, float(lr), causal)
+
+    def place_params(params):
+        return {k: jax.device_put(v, psp) for k, v in params.items()}
+
+    def place_batch(x):
+        return jax.device_put(x, xsh)
+
+    return fn, place_params, place_batch
+
+
+def make_tp_mesh(n_devices: Optional[int] = None,
+                 dp_size: Optional[int] = None,
+                 tp_must_divide: Optional[int] = None):
+    """A 2D (dp, tp) mesh over the available devices.
+
+    ``tp_must_divide`` (typically ``n_heads``): the tensor-parallel axis is
+    chosen among divisors of it, so the Megatron shardings always place —
+    an arbitrary near-square split would crash for device counts whose
+    factors don't divide the head/ff dimensions.
+    """
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if dp_size is None:
+        from .spmd import best_grid
+        dp_size, tp = best_grid(n)
+        if tp_must_divide is not None and tp_must_divide % tp != 0:
+            tp = next(t for t in range(min(tp, tp_must_divide), 0, -1)
+                      if n % t == 0 and tp_must_divide % t == 0)
+            dp_size = n // tp
+    else:
+        tp = n // dp_size
+    assert dp_size * tp == n
+    return Mesh(np.array(devs[:n]).reshape(dp_size, tp), ("dp", "tp"))

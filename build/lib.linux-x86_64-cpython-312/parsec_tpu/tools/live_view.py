@@ -1,0 +1,115 @@
+"""Live counter visualization: periodic sampling + rendered time-series.
+
+Re-design of the reference's ``tools/aggregator_visu`` (a demo server
+exporting MCA counters plus a matplotlib GUI, ``aggregator.py``): a
+background sampler records the counter registry on an interval, and
+:meth:`render` draws the series with matplotlib. Headless-friendly (Agg
+backend) — on a cluster the PNG lands where a dashboard can poll it, which
+is the TPU-pod-operations shape of "live GUI". Cross-rank aggregation at
+fini stays with ``--mca counter_aggregate 1`` (comm/remote_dep.py); this
+module covers the time dimension.
+
+Usage::
+
+    from parsec_tpu.tools.live_view import LiveCounterView
+    view = LiveCounterView(interval_s=0.05)
+    view.start()
+    ... run taskpools ...
+    view.stop()
+    view.render("counters.png")
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.counters import counters as default_registry
+
+
+class LiveCounterView:
+    """Sample a CounterRegistry on an interval; render the series."""
+
+    def __init__(self, registry=None, interval_s: float = 0.1,
+                 max_samples: int = 10000) -> None:
+        self.registry = registry if registry is not None else default_registry
+        self.interval_s = interval_s
+        self.max_samples = max_samples
+        self.times: List[float] = []
+        self.series: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = None
+
+    # ------------------------------------------------------------- sampling
+    def sample(self) -> None:
+        """Record one snapshot (also usable standalone, without start())."""
+        snap = self.registry.snapshot()
+        now = time.perf_counter()
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            if len(self.times) >= self.max_samples:
+                return
+            self.times.append(now - self._t0)
+            for name, v in snap.items():
+                s = self.series.setdefault(name, [0.0] * (len(self.times) - 1))
+                s.append(float(v))
+            for name, s in self.series.items():
+                if len(s) < len(self.times):      # counter appeared late
+                    s.extend([s[-1] if s else 0.0] *
+                             (len(self.times) - len(s)))
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self.sample()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="parsec-tpu-liveview")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._thread = None
+        self.sample()
+
+    # ------------------------------------------------------------- rendering
+    def active_series(self) -> Dict[str, List[float]]:
+        """Counters whose value changed during the observation window."""
+        with self._lock:
+            return {n: list(s) for n, s in self.series.items()
+                    if s and (max(s) != min(s))}
+
+    def render(self, path: str, title: str = "parsec_tpu counters") -> str:
+        """Draw the changing counters as time series (PNG/SVG by suffix)."""
+        import matplotlib
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        active = self.active_series()
+        with self._lock:
+            ts = list(self.times)
+        fig, ax = plt.subplots(figsize=(9, 4.5))
+        if active:
+            for name, s in sorted(active.items()):
+                ax.plot(ts[:len(s)], s, label=name, linewidth=1.2)
+            ax.legend(loc="upper left", fontsize=8)
+        else:
+            ax.text(0.5, 0.5, "no counter activity", ha="center",
+                    transform=ax.transAxes)
+        ax.set_xlabel("seconds")
+        ax.set_ylabel("count")
+        ax.set_title(title)
+        fig.tight_layout()
+        fig.savefig(path)
+        plt.close(fig)
+        return path

@@ -1,0 +1,306 @@
+"""Trace analysis pipeline: PBP binary traces → tables / Chrome trace.
+
+Re-design of the reference's profiling toolchain (tools/profiling):
+``dbpreader`` + the Cython PBT→PTT pandas pipeline (pbt2ptt.pyx,
+parsec_trace_tables.py) and the Chrome-trace converter (h5toctf.py):
+
+* :func:`read_pbp` — parse the binary trace into dictionary + event records.
+* :func:`to_dataframe` — pandas "trace tables": one row per matched
+  begin/end interval with stream, taskpool, duration, unpacked info fields.
+* :func:`to_chrome_trace` — chrome://tracing / Perfetto JSON.
+* CLI: ``python -m parsec_tpu.tools.trace_reader trace.pbp [--ctf out.json]``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.trace import MAGIC, parse_info_desc
+
+
+@dataclass
+class TraceData:
+    t0: float
+    dictionary: List[Dict[str, Any]]
+    streams: List[Dict[str, Any]]   # {name, events: [(key,eid,tp,t,flags,info)]}
+
+
+def read_pbp(path: str) -> TraceData:
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:8] != MAGIC:
+        raise ValueError(f"{path}: not a PBP trace (magic {raw[:8]!r})")
+    off = 8
+    t0, ndict, nstreams = struct.unpack_from("<dII", raw, off)
+    off += struct.calcsize("<dII")
+
+    def read_str() -> str:
+        nonlocal off
+        (n,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        s = raw[off:off + n].decode()
+        off += n
+        return s
+
+    dictionary = []
+    for key in range(ndict):
+        name, attr, info_desc = read_str(), read_str(), read_str()
+        fields, fmt = parse_info_desc(info_desc)
+        dictionary.append({"key": key, "name": name, "attr": attr,
+                           "info_desc": info_desc, "fields": fields,
+                           "fmt": fmt})
+    streams = []
+    for _ in range(nstreams):
+        name = read_str()
+        (nev,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        events = []
+        for _ in range(nev):
+            key, eid, tpid, t, flags, ilen = struct.unpack_from("<IqIdII", raw, off)
+            off += struct.calcsize("<IqIdII")
+            info = raw[off:off + ilen]
+            off += ilen
+            events.append((key, eid, tpid, t, flags, info))
+        streams.append({"name": name, "events": events})
+    return TraceData(t0, dictionary, streams)
+
+
+def _intervals(trace: TraceData):
+    """Match begin/end pairs per (stream, base key, event id)."""
+    for si, stream in enumerate(trace.streams):
+        open_ev: Dict[Tuple[int, int], Tuple[float, bytes, int]] = {}
+        for key, eid, tpid, t, flags, info in stream["events"]:
+            base, is_end = key >> 1, key & 1
+            if not is_end:
+                open_ev[(base, eid)] = (t, info, tpid)
+            else:
+                start = open_ev.pop((base, eid), None)
+                if start is None:
+                    continue
+                t_s, info_s, tpid_s = start
+                yield si, stream["name"], base, eid, tpid_s, t_s, t, info_s
+
+
+def to_dataframe(trace: TraceData):
+    """The PTT role: one pandas row per begin/end interval."""
+    import pandas as pd
+    rows = []
+    for si, sname, base, eid, tpid, t_s, t_e, info in _intervals(trace):
+        d = trace.dictionary[base]
+        row = {
+            "stream": sname,
+            "stream_id": si,
+            "name": d["name"],
+            "event_id": eid,
+            "taskpool_id": tpid,
+            "begin": t_s - trace.t0,
+            "end": t_e - trace.t0,
+            "duration": t_e - t_s,
+        }
+        if d["fields"] and info:
+            vals = struct.unpack(d["fmt"], info)
+            row.update({fname: v for (fname, _), v in zip(d["fields"], vals)})
+        rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def to_chrome_trace(trace: TraceData) -> Dict[str, Any]:
+    """Chrome trace-event JSON (the h5toctf.py role): load into Perfetto."""
+    events = []
+    for si, sname, base, eid, tpid, t_s, t_e, info in _intervals(trace):
+        d = trace.dictionary[base]
+        events.append({
+            "name": d["name"],
+            "cat": f"taskpool{tpid}",
+            "ph": "X",
+            "ts": (t_s - trace.t0) * 1e6,
+            "dur": (t_e - t_s) * 1e6,
+            "pid": 0,
+            "tid": si,
+            "args": {"event_id": eid},
+        })
+    meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": si,
+             "args": {"name": s["name"]}}
+            for si, s in enumerate(trace.streams)]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+_SVG_COLORS = ["#4c72b0", "#dd8452", "#55a868", "#c44e52", "#8172b3",
+               "#937860", "#da8bc3", "#8c8c8c", "#ccb974", "#64b5cd"]
+
+
+def to_animated_svg(trace: TraceData, playback_s: float = 5.0) -> str:
+    """Self-contained animated SVG: a Gantt of the execution that draws
+    itself in playback order (SMIL timing) — the role of the reference's
+    trace animation tool (tools/profiling/animation.c), with no external
+    renderer. One lane per stream, one color per keyword; each task
+    interval fades in at its (scaled) begin time."""
+    ivs = list(_intervals(trace))
+    if not ivs:
+        return "<svg xmlns='http://www.w3.org/2000/svg'/>"
+    t0 = min(iv[5] for iv in ivs)
+    t1 = max(iv[6] for iv in ivs)
+    span = max(t1 - t0, 1e-9)
+    lane_h, pad, width = 26, 30, 960
+    lanes = len(trace.streams)
+    height = pad * 2 + lanes * lane_h
+    color = {d["key"]: _SVG_COLORS[i % len(_SVG_COLORS)]
+             for i, d in enumerate(trace.dictionary)}
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+           f'height="{height}" font-family="monospace" font-size="10">']
+    for si, s in enumerate(trace.streams):
+        y = pad + si * lane_h
+        out.append(f'<text x="2" y="{y + lane_h - 10}" '
+                   f'fill="#333">{s["name"][:14]}</text>')
+        out.append(f'<line x1="{pad + 90}" y1="{y + lane_h - 4}" '
+                   f'x2="{width - 10}" y2="{y + lane_h - 4}" '
+                   f'stroke="#ddd"/>')
+    x0, x1 = pad + 90, width - 10
+    for si, sname, base, eid, tpid, tb, te, info in ivs:
+        bx = x0 + (tb - t0) / span * (x1 - x0)
+        w = max((te - tb) / span * (x1 - x0), 1.0)
+        y = pad + si * lane_h
+        begin = (tb - t0) / span * playback_s
+        name = trace.dictionary[base]["name"]
+        out.append(
+            f'<rect x="{bx:.1f}" y="{y + 4}" width="{w:.1f}" '
+            f'height="{lane_h - 10}" fill="{color[base]}" opacity="0">'
+            f'<title>{name} #{eid} [{(tb - t0)*1e3:.2f}..'
+            f'{(te - t0)*1e3:.2f} ms]</title>'
+            f'<set attributeName="opacity" to="0.9" '
+            f'begin="{begin:.3f}s" fill="freeze"/></rect>')
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def read_otf2(path: str) -> TraceData:
+    """Read a PTF2 archive (the OTF2-class backend) into the same model as
+    PBP files, so the whole analysis pipeline is format-agnostic."""
+    from ..utils.trace_otf2 import read_archive
+    d = read_archive(path)
+    dictionary = []
+    for e in d["dictionary"]:
+        fields, fmt = parse_info_desc(e["info_desc"])
+        dictionary.append({**e, "fields": fields, "fmt": fmt})
+    return TraceData(d["t0"], dictionary, d["streams"])
+
+
+def read_trace(path: str) -> TraceData:
+    """Format dispatch: PTF2 archives are directories, PBP traces files."""
+    import os
+    if os.path.isdir(path):
+        return read_otf2(path)
+    return read_pbp(path)
+
+
+def comm_events(trace: TraceData) -> List[Dict[str, Any]]:
+    """Extract typed comm-stream events (``comm::*`` keywords) with their
+    decoded src/dst/bytes info blobs (ref: the comm-thread stream written
+    by remote_dep_mpi.c:1286-1302)."""
+    by_key = {d["key"]: d for d in trace.dictionary}
+    out: List[Dict[str, Any]] = []
+    for stream in trace.streams:
+        for key, eid, tpid, t, flags, info in stream["events"]:
+            entry = by_key.get(key >> 1)
+            if entry is None or not entry["name"].startswith("comm::"):
+                continue
+            ev = {"kind": entry["name"][len("comm::"):], "t": t,
+                  "stream": stream["name"], "event_id": eid}
+            if entry["fields"] and info:
+                vals = struct.unpack(entry["fmt"], info)
+                ev.update({n: v for (n, _), v in zip(entry["fields"], vals)})
+            out.append(ev)
+    return out
+
+
+def check_comms(paths: List[str]) -> Dict[str, Any]:
+    """Cross-rank validation of the comm streams (the check-comms.py role,
+    ref: tests/profiling/check-comms.py): every send event recorded by one
+    rank must have a matching receive on the destination rank with the
+    same (src, dst, bytes), for each protocol leg (activate/get/put).
+
+    ``paths[i]`` is rank i's PBP file. Returns a summary dict with an
+    ``errors`` list (empty = consistent).
+    """
+    pairs = [("activate_snd", "activate_rcv"), ("get_snd", "get_rcv"),
+             ("put_snd", "put_rcv")]
+    per_rank = [comm_events(read_trace(p)) for p in paths]
+    errors: List[str] = []
+    counts: Dict[str, int] = {}
+    for snd_kind, rcv_kind in pairs:
+        # multiset of (src, dst, bytes) on each side
+        snd: Dict[Tuple, int] = {}
+        rcv: Dict[Tuple, int] = {}
+        for rank, evs in enumerate(per_rank):
+            for ev in evs:
+                if ev["kind"] == snd_kind:
+                    if ev.get("src") != rank:
+                        errors.append(f"{snd_kind} recorded on rank {rank} "
+                                      f"but src={ev.get('src')}")
+                    k = (ev.get("src"), ev.get("dst"), ev.get("bytes"))
+                    snd[k] = snd.get(k, 0) + 1
+                elif ev["kind"] == rcv_kind:
+                    if ev.get("dst") != rank:
+                        errors.append(f"{rcv_kind} recorded on rank {rank} "
+                                      f"but dst={ev.get('dst')}")
+                    k = (ev.get("src"), ev.get("dst"), ev.get("bytes"))
+                    rcv[k] = rcv.get(k, 0) + 1
+        counts[snd_kind] = sum(snd.values())
+        counts[rcv_kind] = sum(rcv.values())
+        for k, n in snd.items():
+            if rcv.get(k, 0) != n:
+                errors.append(f"{snd_kind} {k} sent {n}x but received "
+                              f"{rcv.get(k, 0)}x")
+        for k, n in rcv.items():
+            if k not in snd:
+                errors.append(f"{rcv_kind} {k} received with no matching send")
+    # protocol shape: every rendezvous put pairs with exactly one get
+    if counts.get("put_snd", 0) != counts.get("get_rcv", 0):
+        errors.append(f"put_snd={counts.get('put_snd')} != "
+                      f"get_rcv={counts.get('get_rcv')}")
+    return {"ranks": len(paths), "counts": counts, "errors": errors}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: trace_reader <trace.pbp|archive.ptf2> "
+              "[--ctf out.json] [--csv out.csv] [--svg out.svg]\n"
+              "       trace_reader --check-comms <rank0.pbp> <rank1.pbp> ...",
+              file=sys.stderr)
+        return 2
+    if argv[0] == "--check-comms":
+        summary = check_comms(argv[1:])
+        print(json.dumps(summary))
+        return 1 if summary["errors"] else 0
+    trace = read_trace(argv[0])
+    print(f"trace: {len(trace.dictionary)} keywords, "
+          f"{len(trace.streams)} streams, "
+          f"{sum(len(s['events']) for s in trace.streams)} events")
+    if "--ctf" in argv:
+        out = argv[argv.index("--ctf") + 1]
+        with open(out, "w") as f:
+            json.dump(to_chrome_trace(trace), f)
+        print(f"chrome trace -> {out}")
+    if "--csv" in argv:
+        out = argv[argv.index("--csv") + 1]
+        to_dataframe(trace).to_csv(out, index=False)
+        print(f"trace tables -> {out}")
+    if "--svg" in argv:
+        out = argv[argv.index("--svg") + 1]
+        with open(out, "w") as f:
+            f.write(to_animated_svg(trace))
+        print(f"animated gantt -> {out}")
+    if not any(f in argv for f in ("--ctf", "--csv", "--svg")):
+        df = to_dataframe(trace)
+        if len(df):
+            print(df.groupby("name")["duration"].describe())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,86 @@
+"""Properties dictionary + software-defined counter export.
+
+Re-design of parsec/dictionary.c (live properties registry) and
+parsec/papi_sde.c (PAPI software-defined events exposing runtime counters —
+pending tasks, tasks enabled, tasks retired; scheduling.c:330-337,491).
+Counters register once and are sampled on read; an aggregation hook serves
+the live-visualization role of tools/aggregator_visu.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Union
+
+Sampler = Callable[[], Union[int, float]]
+
+# canonical counter names (ref: PAPI_SDE parsec::SCHEDULER::PENDING_TASKS etc.)
+PENDING_TASKS = "scheduler.pending_tasks"
+TASKS_ENABLED = "scheduler.tasks_enabled"
+TASKS_RETIRED = "scheduler.tasks_retired"
+
+
+class CounterRegistry:
+    """Process-wide named counters: either atomic accumulators or samplers."""
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {}
+        self._samplers: Dict[str, Sampler] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, sampler: Optional[Sampler] = None) -> None:
+        with self._lock:
+            if sampler is not None:
+                self._samplers[name] = sampler
+            else:
+                self._acc.setdefault(name, 0)
+
+    def add(self, name: str, v: Union[int, float] = 1) -> None:
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0) + v
+
+    def set(self, name: str, v: Union[int, float]) -> None:
+        with self._lock:
+            self._acc[name] = v
+
+    def read(self, name: str) -> Union[int, float]:
+        s = self._samplers.get(name)
+        if s is not None:
+            return s()
+        with self._lock:
+            return self._acc.get(name, 0)
+
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        """All counters at once (the aggregator_visu export)."""
+        out: Dict[str, Union[int, float]] = {}
+        with self._lock:
+            out.update(self._acc)
+            samplers = dict(self._samplers)
+        for name, s in samplers.items():
+            try:
+                out[name] = s()
+            except Exception:  # noqa: BLE001 - sampling must never break
+                out[name] = float("nan")
+        return out
+
+
+counters = CounterRegistry()
+
+
+def install_scheduler_counters(context) -> None:
+    """Wire the canonical scheduler counters onto a context via PINS."""
+    from ..core import pins as P
+
+    counters.register(TASKS_ENABLED)
+    counters.register(TASKS_RETIRED)
+    counters.register(PENDING_TASKS, sampler=lambda: (
+        counters.read(TASKS_ENABLED) - counters.read(TASKS_RETIRED)))
+
+    def on_sched(stream, tasks, extra) -> None:
+        counters.add(TASKS_ENABLED, len(tasks) if isinstance(tasks, list) else 1)
+
+    def on_complete(stream, task, extra) -> None:
+        counters.add(TASKS_RETIRED, 1)
+
+    context.pins.register(P.SCHEDULE_END, on_sched)
+    context.pins.register(P.COMPLETE_EXEC_END, on_complete)

@@ -1,0 +1,60 @@
+"""Model/training-state checkpointing via orbax.
+
+The runtime side of checkpoint/resume (tile collections at quiescence) is
+:mod:`parsec_tpu.utils.checkpoint`; this module is the MODEL side: save and
+restore a whole training state — params pytree, optax optimizer state, step
+counter — through orbax's checkpointer, which handles jax arrays (incl.
+sharded ones: restoring against a sharded ``like`` pytree places leaves back
+on their mesh shardings).
+
+    from parsec_tpu.utils.model_ckpt import save_train_state, restore_train_state
+    save_train_state(path, params, opt_state, step=1000)
+    params, opt_state, step = restore_train_state(path, like=(params0, opt0))
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_train_state(path: str, params: Any, opt_state: Any = None,
+                     step: int = 0, force: bool = True) -> str:
+    """Write ``{params, opt_state, step}`` atomically under ``path``
+    (a directory; orbax finalizes it only when complete)."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    state = {"params": params, "opt_state": opt_state, "step": step}
+    ckpt.save(path, state, force=force)
+    ckpt.wait_until_finished()
+    return path
+
+
+def restore_train_state(path: str, like: Optional[Tuple[Any, Any]] = None
+                        ) -> Tuple[Any, Any, int]:
+    """Restore ``(params, opt_state, step)``.
+
+    ``like=(params_like, opt_state_like)`` gives the target structure —
+    required to get optax NamedTuple states (not plain dicts) back, and to
+    restore leaves onto sharded placements: pass pytrees of arrays (or
+    ShapeDtypeStructs with shardings) shaped like the saved state."""
+    import jax
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    if like is None:
+        state = ckpt.restore(path)
+    else:
+        p_like, o_like = like
+        target = {
+            "params": jax.tree_util.tree_map(lambda x: x, p_like),
+            "opt_state": None if o_like is None
+            else jax.tree_util.tree_map(lambda x: x, o_like),
+            "step": 0,
+        }
+        state = ckpt.restore(path, target)
+    return state["params"], state["opt_state"], int(state["step"])

@@ -1,0 +1,73 @@
+"""XLA/HLO-level tracing bridge.
+
+The role profiling_nvtx.c plays in the reference (annotating runtime spans
+for the vendor profiler) maps on TPU to ``jax.profiler``: device-side HLO
+timelines captured into TensorBoard/Perfetto format, with runtime task spans
+annotated via TraceAnnotation so kernel activity lines up with task names
+(BASELINE.json: "swap profiling_nvtx for XLA HLO tracing").
+
+Usage::
+
+    with xla_trace("/tmp/tb"):            # device + host timeline
+        ... run taskpools ...
+
+or annotate spans manually through :class:`TaskAnnotator` (a PINS module).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from ..core import pins as P
+from . import mca, output
+
+mca.register("profile_xla_dir", "", "Capture a jax.profiler trace into this dir")
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: Optional[str] = None) -> Iterator[None]:
+    """Capture a jax.profiler trace around a region (no-op without a dir)."""
+    logdir = logdir or mca.get("profile_xla_dir", "")
+    if not logdir:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        output.inform(f"XLA trace captured to {logdir}")
+
+
+class TaskAnnotator:
+    """PINS module: wrap task execution in jax.profiler.TraceAnnotation so
+    device kernels group under their task names in the timeline (the NVTX
+    range push/pop role)."""
+
+    name = "xla_annotator"
+
+    def __init__(self) -> None:
+        self._open = {}
+
+    def enable(self, context) -> None:
+        self.context = context
+        context.pins.register(P.EXEC_BEGIN, self._begin)
+        context.pins.register(P.EXEC_END, self._end)
+
+    def disable(self, context) -> None:
+        context.pins.unregister(P.EXEC_BEGIN, self._begin)
+        context.pins.unregister(P.EXEC_END, self._end)
+
+    def _begin(self, stream, task, extra) -> None:
+        import jax
+        ann = jax.profiler.TraceAnnotation(
+            f"{task.taskpool.name}::{task.task_class.name}")
+        ann.__enter__()
+        self._open[id(task)] = ann
+
+    def _end(self, stream, task, extra) -> None:
+        ann = self._open.pop(id(task), None)
+        if ann is not None:
+            ann.__exit__(None, None, None)

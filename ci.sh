@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# CI entry point (the Jenkinsfile role, ref: Jenkinsfile:1): build the
+# native pieces, lint the tree, run the unit suite, smoke the examples and
+# the driver entry. Exits non-zero on any failure.
+#
+# Usage: ./ci.sh [quick]   — "quick" skips the full pytest suite and runs
+# the smoke set only (native build + compile checks + one example).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+make -C native "PYTHON=$(command -v python3)"
+
+echo "== byte-compile lint (syntax over the whole tree) =="
+python3 -m compileall -q parsec_tpu tests examples benchmarks bench.py \
+    __graft_entry__.py setup.py
+
+echo "== CLI smoke =="
+python3 -m parsec_tpu --version
+python3 -m parsec_tpu --help-mca > /dev/null
+
+echo "== example smoke (CPU) =="
+EXAMPLES_CPU=1 timeout 180 python3 examples/ex04_chain_data.py
+
+if [ "${1:-}" = "quick" ]; then
+    echo "== quick suite =="
+    timeout 600 python3 -m pytest tests/test_core_dag.py tests/test_dtd.py \
+        tests/test_native_dtd.py tests/test_ptg.py -q -x
+else
+    echo "== full suite =="
+    timeout 1800 python3 -m pytest tests/ -q -x
+fi
+
+echo "== driver entry compile-check (8 virtual devices) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout 600 python3 __graft_entry__.py 8 > /dev/null
+
+echo "CI OK"

@@ -120,6 +120,29 @@ PyObject *engine_insert(PyObject *obj, PyObject *args) {
 
     std::vector<TaskRec> &tasks = *self->tasks;
     std::vector<TileRec> &tiles = *self->tiles;
+
+    // validate EVERYTHING before mutating any chain state: a mid-loop
+    // failure after linking flow 0 would leave successor edges (and
+    // possibly tile.last_writer) pointing at a popped — soon reused — id
+    constexpr Py_ssize_t PT_FLOWS_MAX = 64;
+    if (nflows > PT_FLOWS_MAX) {
+        PyErr_SetString(PyExc_ValueError, "too many flows (max 64)");
+        return nullptr;
+    }
+    int64_t tixs[PT_FLOWS_MAX];
+    long laccs[PT_FLOWS_MAX];
+    for (Py_ssize_t i = 0; i < nflows; i++) {
+        tixs[i] = PyLong_AsLongLong(
+            til ? PyList_GET_ITEM(tile_ids, i)
+                : PyTuple_GET_ITEM(tile_ids, i));
+        laccs[i] = PyLong_AsLong(acl ? PyList_GET_ITEM(accs, i)
+                                     : PyTuple_GET_ITEM(accs, i));
+        if (!PyErr_Occurred() &&
+            (tixs[i] < 0 || (size_t)tixs[i] >= tiles.size()))
+            PyErr_SetString(PyExc_IndexError, "bad tile id");
+        if (PyErr_Occurred()) return nullptr;
+    }
+
     const int64_t tid = (int64_t)tasks.size();
     tasks.emplace_back();
     self->live++;
@@ -132,18 +155,8 @@ PyObject *engine_insert(PyObject *obj, PyObject *args) {
     int32_t new_deps = 0;
 
     for (Py_ssize_t i = 0; i < nflows; i++) {
-        int64_t tix = PyLong_AsLongLong(
-            til ? PyList_GET_ITEM(tile_ids, i)
-                : PyTuple_GET_ITEM(tile_ids, i));
-        long acc = PyLong_AsLong(acl ? PyList_GET_ITEM(accs, i)
-                                     : PyTuple_GET_ITEM(accs, i));
-        if ((tix < 0 || (size_t)tix >= tiles.size()) && !PyErr_Occurred())
-            PyErr_SetString(PyExc_IndexError, "bad tile id");
-        if (PyErr_Occurred()) {
-            tasks.pop_back();
-            self->live--;
-            return nullptr;
-        }
+        int64_t tix = tixs[i];
+        long acc = laccs[i];
         TileRec &tile = tiles[(size_t)tix];
         const bool is_read = (acc & ACC_READ) || !(acc & ACC_WRITE);
         if (is_read) {

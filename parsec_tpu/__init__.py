@@ -21,7 +21,7 @@ Layer map (mirrors SURVEY.md §1):
   tools/   — trace readers/converters                     (ref L7)
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 from .core.context import Context, init, fini
 from .core.task import (

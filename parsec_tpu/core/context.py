@@ -159,9 +159,12 @@ class Context:
         self._work_event = threading.Event()
         self._error: Optional[BaseException] = None
         self._prio_seen = False   # any nonzero-priority task ever scheduled
-        #: callables invoked when a progress loop starts or starves —
-        #: producers holding amortization buffers (the DTD ready batch)
-        #: drain here so direct _progress_loop users see their tasks
+        #: weak bound-method refs invoked when a progress loop starts or
+        #: starves — producers holding amortization buffers (the DTD ready
+        #: batch) drain here so direct _progress_loop users see their
+        #: tasks. WEAK on purpose: a dropped taskpool must not be pinned
+        #: alive (or keep costing a call per starved iteration) just
+        #: because it once registered a hook
         self._drain_hooks: List = []
         # per-thread stream binding (was a thread-NAME parse on every
         # schedule() — the single hottest line of the EP profile)
@@ -174,6 +177,26 @@ class Context:
         self._gc_held = False
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
+
+    def register_drain_hook(self, bound_method) -> None:
+        import weakref
+        self._drain_hooks.append(weakref.WeakMethod(bound_method))
+
+    def unregister_drain_hook(self, bound_method) -> None:
+        self._drain_hooks = [r for r in self._drain_hooks
+                             if r() is not None and r() != bound_method]
+
+    def _run_drain_hooks(self) -> None:
+        dead = False
+        for ref in tuple(self._drain_hooks):
+            fn = ref()
+            if fn is None:
+                dead = True
+                continue
+            fn()
+        if dead:
+            self._drain_hooks = [r for r in self._drain_hooks
+                                 if r() is not None]
 
     # ------------------------------------------------------------------ setup
     def add_taskpool(self, tp: Taskpool) -> None:
@@ -349,8 +372,7 @@ class Context:
         misses = 0
         deadline = None if timeout is None else time.monotonic() + timeout
         backoff_max = mca.get("runtime_backoff_max_us", 1000) / 1e6
-        for h in tuple(self._drain_hooks):
-            h()
+        self._run_drain_hooks()
         while not until():
             if self._error is not None:
                 if stream.is_master:
@@ -442,8 +464,7 @@ class Context:
                 did_something = True
             if not did_something:
                 misses += 1
-                for h in tuple(self._drain_hooks):   # starving: drain any
-                    h()                              # amortization buffers
+                self._run_drain_hooks()   # starving: drain buffers
                 if deadline is not None and time.monotonic() > deadline:
                     return
                 # exponential backoff while starving (ref: scheduling.c:801-804)
@@ -677,7 +698,12 @@ class Context:
                             remote_by_dtt.setdefault(wire, set()).add(r)
                             continue
                     visit(dep, tl)
-                    nb_uses += 1
+                    if not (flow.access & FLOW_ACCESS_CTL):
+                        # CTL consumers never look the entry up (their
+                        # prepare_input skips data resolution), so counting
+                        # them in the usage limit would make the entry
+                        # unretirable
+                        nb_uses += 1
             if remote_by_dtt:
                 slot = task.data[flow.flow_index]
                 out = slot.data_out if slot.data_out is not None else slot.data_in

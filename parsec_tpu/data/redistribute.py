@@ -29,6 +29,16 @@ def _frag_copy(dst, src, sr, sc, tr, tc, h, w):
     return out
 
 
+def _tile_move(dst, src):
+    """Whole-tile move (the reshuffle fast path, ref:
+    redistribute_reshuffle.jdf:1-128): same geometry + aligned offsets map
+    each target tile to exactly ONE source tile, so the payload moves by
+    reference — no slice, no copy. Safe under the runtime's functional
+    tile discipline (bodies never mutate inputs in place; a later write to
+    either tile REPLACES its payload)."""
+    return src
+
+
 def redistribute(tp: DTDTaskpool, S: TiledMatrix, T: TiledMatrix,
                  m: Optional[int] = None, n: Optional[int] = None,
                  si: int = 0, sj: int = 0, ti: int = 0, tj: int = 0) -> int:
@@ -44,6 +54,15 @@ def redistribute(tp: DTDTaskpool, S: TiledMatrix, T: TiledMatrix,
     assert ti + m <= T.lm and tj + n <= T.ln, "target region out of bounds"
     n0 = tp.inserted
 
+    # reshuffle fast path precondition: identical tile geometry AND dtype
+    # (the fragment path casts through the target's dtype on assignment;
+    # a by-reference move must not change a collection's dtype) and
+    # congruent offsets — every FULL target tile then maps to exactly one
+    # source tile and moves whole, by reference (no fragment algebra)
+    same_geom = (S.mb == T.mb and S.nb == T.nb
+                 and getattr(S, "dtype", None) == getattr(T, "dtype", None)
+                 and (si - ti) % S.mb == 0 and (sj - tj) % S.nb == 0)
+
     # iterate target tiles touched by the region
     t_m0, t_m1 = ti // T.mb, (ti + m - 1) // T.mb
     t_n0, t_n1 = tj // T.nb, (tj + n - 1) // T.nb
@@ -54,6 +73,15 @@ def redistribute(tp: DTDTaskpool, S: TiledMatrix, T: TiledMatrix,
             r1 = min((tm + 1) * T.mb, ti + m) - ti
             c0 = max(tn * T.nb, tj) - tj
             c1 = min((tn + 1) * T.nb, tj + n) - tj
+            if same_geom and (ti + r0) % T.mb == 0 and r1 - r0 == T.mb \
+                    and (tj + c0) % T.nb == 0 and c1 - c0 == T.nb:
+                # whole aligned tile: one move task, zero copies
+                sm, sn = (si + r0) // S.mb, (sj + c0) // S.nb
+                tp.insert_task(_tile_move,
+                               (tp.tile_of(T, tm, tn), RW | AFFINITY),
+                               (tp.tile_of(S, sm, sn), READ),
+                               name="reshuffle", jit=False)
+                continue
             # source tiles intersecting [r0:r1, c0:c1] (region coords)
             s_m0, s_m1 = (si + r0) // S.mb, (si + r1 - 1) // S.mb
             s_n0, s_n1 = (sj + c0) // S.nb, (sj + c1 - 1) // S.nb

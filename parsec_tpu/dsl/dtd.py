@@ -396,8 +396,9 @@ class DTDTaskpool(Taskpool):
                 ctx._dtd_ntasks = {}
         if eng is not None:
             # progress loops drain our ready buffer even when the user
-            # drives the context directly (no tp.wait())
-            ctx._drain_hooks.append(self._flush_ready)
+            # drives the context directly (no tp.wait()); weakly bound so
+            # a dropped pool unregisters itself
+            ctx.register_drain_hook(self._flush_ready)
         self._neng = eng
         return eng
 
@@ -1002,10 +1003,7 @@ class DTDTaskpool(Taskpool):
             self._capture.execute()
         self._flush_ready()
         if self._neng is not None:
-            try:
-                self.ctx._drain_hooks.remove(self._flush_ready)
-            except ValueError:
-                pass
+            self.ctx.unregister_drain_hook(self._flush_ready)
         if self._open:
             self._open = False
             self.addto_nb_pending_actions(-1)

@@ -1,0 +1,49 @@
+"""Build hooks for the native pieces (metadata lives in pyproject.toml).
+
+Two native artifacts ship inside the wheel:
+
+* ``parsec_tpu._ptdtd`` — the CPython-extension DTD dependency engine
+  (native/src/ptdtd.cpp), a standard Extension.
+* ``parsec_tpu._ptcore`` — the C-ABI core (dep table / zone allocator /
+  deque; native/src/ptcore.cpp), loaded via ctypes. Building it as an
+  Extension is deliberate: it needs no Python symbols, but the Extension
+  machinery gives a portable compile+install path and ctypes can dlopen an
+  ABI-suffixed .so just fine (parsec_tpu/native.py searches the package
+  directory first, then the in-tree native/build/).
+
+Both are OPTIONAL: the runtime falls back to pure Python when they are
+missing, so a toolchain-less install still works (``--no-build-isolation``
+environments, exotic platforms). The reference's analogue is the CMake
+feature probe tree (CMakeLists.txt:1): features degrade, builds don't fail.
+"""
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+
+class optional_build_ext(build_ext):
+    """Never let a missing toolchain fail the install."""
+
+    def run(self):
+        try:
+            super().run()
+        except Exception as e:  # noqa: BLE001
+            print(f"WARNING: native extensions skipped ({e}); "
+                  f"parsec_tpu will use its pure-Python fallbacks")
+
+    def build_extension(self, ext):
+        try:
+            super().build_extension(ext)
+        except Exception as e:  # noqa: BLE001
+            print(f"WARNING: {ext.name} skipped ({e})")
+
+
+setup(
+    ext_modules=[
+        Extension("parsec_tpu._ptdtd", ["native/src/ptdtd.cpp"],
+                  extra_compile_args=["-O3", "-std=c++17"]),
+        Extension("parsec_tpu._ptcore", ["native/src/ptcore.cpp"],
+                  extra_compile_args=["-O3", "-std=c++17"]),
+    ],
+    cmdclass={"build_ext": optional_build_ext},
+)
