@@ -811,7 +811,41 @@ def main() -> None:
     print(json.dumps(results))
 
 
+def await_tpu(max_hours: float = 12.0) -> None:
+    """Watchdog (VERDICT r4 #2): re-probe the relay on a backoff loop and
+    run the FULL bench the moment a chip appears; every probe is logged so
+    a dead relay leaves a continuous evidence trail instead of silence."""
+    logp = os.path.join(REPO, "docs", "relay_probes_r5.log")
+    os.makedirs(os.path.dirname(logp), exist_ok=True)
+    deadline = time.time() + max_hours * 3600
+    k = 0
+    while time.time() < deadline:
+        platform, kind, attempts = probe_accelerator()
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(logp, "a") as f:
+            if platform in ("tpu", "axon", "gpu"):
+                f.write(f"{stamp} ALIVE platform={platform} kind={kind} "
+                        f"-> running full bench\n")
+            else:
+                f.write(f"{stamp} dead "
+                        f"(probe rc={attempts[-1].get('rc')!r}, "
+                        f"{attempts[-1].get('secs')}s)\n")
+        if platform in ("tpu", "axon", "gpu"):
+            os.environ["PT_BENCH_PLATFORM"] = platform
+            main()
+            return
+        k += 1
+        time.sleep(min(300 * k, 1800))
+    log(f"await-tpu: relay dead for the full {max_hours}h window")
+
+
 if __name__ == "__main__":
+    if "--await-tpu" in sys.argv:
+        hrs = 12.0
+        if "--hours" in sys.argv:
+            hrs = float(sys.argv[sys.argv.index("--hours") + 1])
+        await_tpu(hrs)
+        raise SystemExit(0)
     if "--leg" in sys.argv:
         leg = sys.argv[sys.argv.index("--leg") + 1]
         plat = sys.argv[sys.argv.index("--platform") + 1] \

@@ -214,6 +214,65 @@ class ALPerf(PinsModule):
         return r
 
 
+class HWCounters(PinsModule):
+    """Hardware PMU counters around task execution (the PAPI role, ref:
+    parsec/mca/pins/papi/ — mod_papi.c samples counters at EXEC begin/end
+    through libpapi; here raw perf_event_open, utils/perf_event.py).
+
+    Accumulates per-task-class deltas (cycles, instructions, ...); a host
+    where perf_event is unavailable (seccomp, paranoid level, no PMU)
+    yields a module that enables as a NO-OP — same shape as the reference
+    only building pins/papi when libpapi exists."""
+
+    name = "hw_counters"
+
+    def __init__(self, events=("cycles", "instructions")) -> None:
+        from ..utils import perf_event
+        self._pe = perf_event
+        self.events = tuple(events)
+        self.active = perf_event.available()
+        self._hw = None
+        self._pending: Dict[int, Dict[str, int]] = {}
+        self.per_class: Dict[str, Dict[str, int]] = defaultdict(
+            lambda: defaultdict(int))
+        self.tasks_sampled = 0
+
+    def _register(self, pins) -> None:
+        if not self.active:
+            output.debug_verbose(
+                1, "pins", "hw_counters: perf_event unavailable; no-op")
+            return
+        self._hw = self._pe.HWCounterSet(self.events)
+        self._hw.start()
+        pins.register(P.EXEC_BEGIN, self._on_begin)
+        pins.register(P.EXEC_END, self._on_end)
+
+    def _unregister(self, pins) -> None:
+        if not self.active:
+            return
+        pins.unregister(P.EXEC_BEGIN, self._on_begin)
+        pins.unregister(P.EXEC_END, self._on_end)
+        if self._hw is not None:
+            self._hw.close()
+            self._hw = None
+
+    def _on_begin(self, stream, task, extra) -> None:
+        self._pending[id(task)] = self._hw.read()
+
+    def _on_end(self, stream, task, extra) -> None:
+        t0 = self._pending.pop(id(task), None)
+        if t0 is None:
+            return
+        t1 = self._hw.read()
+        acc = self.per_class[task.task_class.name]
+        for k in self.events:
+            acc[k] += t1[k] - t0[k]
+        self.tasks_sampled += 1
+
+    def report(self) -> Dict[str, Dict[str, int]]:
+        return {cls: dict(v) for cls, v in self.per_class.items()}
+
+
 def ptg_to_dtd_replay(ptg_taskpool, ctx, name: Optional[str] = None,
                       capture: bool = False):
     """Replay a PTG taskpool's task space through the DTD frontend.

@@ -177,10 +177,10 @@ def to_animated_svg(trace: TraceData, playback_s: float = 5.0) -> str:
     return "\n".join(out)
 
 
-def read_otf2(path: str) -> TraceData:
+def read_ptf2(path: str) -> TraceData:
     """Read a PTF2 archive (the OTF2-class backend) into the same model as
     PBP files, so the whole analysis pipeline is format-agnostic."""
-    from ..utils.trace_otf2 import read_archive
+    from ..utils.trace_ptf2 import read_archive
     d = read_archive(path)
     dictionary = []
     for e in d["dictionary"]:
@@ -193,7 +193,7 @@ def read_trace(path: str) -> TraceData:
     """Format dispatch: PTF2 archives are directories, PBP traces files."""
     import os
     if os.path.isdir(path):
-        return read_otf2(path)
+        return read_ptf2(path)
     return read_pbp(path)
 
 

@@ -469,3 +469,55 @@ def test_mem_view_reads_ptf2_archive(ctx, tmp_path):
         [(r["resident"], r["delta"]) for r in rows_otf] == \
         [(1024, 1024), (3072, 2048), (2048, -1024), (2560, 512)]
     assert mem_view.summarize(read_trace(arch))["dev0"]["peak"] == 3072
+
+
+def test_ptf2_is_the_backend_name_and_otf2_warns(ctx, tmp_path):
+    """The second backend is named for what it is (a private
+    OTF2-architecture format): 'ptf2' selects it; 'otf2' still works as a
+    deprecated alias."""
+    from parsec_tpu.utils.trace import Profiling
+    prof = Profiling()
+    TaskProfiler(prof).enable(ctx)
+    _run_chain(ctx, 3)
+    arch = prof.dump(str(tmp_path / "p"), backend="ptf2")
+    assert arch.endswith(".ptf2")
+    import os
+    assert os.path.isdir(arch)
+    arch2 = prof.dump(str(tmp_path / "q"), backend="otf2")   # alias
+    assert os.path.isdir(arch2)
+
+
+def test_hw_counters_pins_module(ctx):
+    """The PAPI-role PINS module: samples per-class PMU deltas where
+    perf_event works, enables as a NO-OP where it does not (this
+    container blocks the syscall — both paths are the contract)."""
+    from parsec_tpu.core.pins_modules import HWCounters
+    from parsec_tpu.utils import perf_event
+
+    hw = HWCounters()
+    hw.enable(ctx)
+    try:
+        _run_chain(ctx, 8)
+        if perf_event.available():
+            rep = hw.report()
+            assert hw.tasks_sampled >= 8
+            cls = next(iter(rep.values()))
+            assert cls.get("cycles", 0) > 0
+        else:
+            assert hw.tasks_sampled == 0       # clean no-op
+    finally:
+        hw.disable(ctx)
+
+
+def test_perf_event_attr_layout():
+    """The hand-packed perf_event_attr must be exactly
+    PERF_ATTR_SIZE_VER7 bytes with the flags word at offset 40."""
+    from parsec_tpu.utils import perf_event as pe
+    raw = pe._attr_bytes(pe.EVENTS["cycles"])
+    assert len(raw) == 128
+    import struct
+    t, size = struct.unpack_from("II", raw, 0)
+    assert t == 0 and size == 128
+    (flags,) = struct.unpack_from("Q", raw, 40)
+    assert flags & 0x1          # disabled at open
+    assert flags & (1 << 5)     # exclude_kernel
