@@ -17,7 +17,8 @@ from typing import Any, Dict, List, Optional
 class DataRepoEntry:
     """Ref: data_repo_entry_t (parsec/datarepo.h:74-90)."""
 
-    __slots__ = ("key", "data", "usagelmt", "usagecnt", "retained", "_repo")
+    __slots__ = ("key", "data", "usagelmt", "usagecnt", "retained", "_repo",
+                 "_mp_owner")
 
     def __init__(self, repo: "DataRepo", key: Any, nb_flows: int) -> None:
         self.key = key
@@ -29,13 +30,30 @@ class DataRepoEntry:
 
 
 class DataRepo:
-    """Hash table of repo entries for one task class (ref: datarepo.c)."""
+    """Hash table of repo entries for one task class (ref: datarepo.c).
+
+    Entries come from a thread-affine :class:`~parsec_tpu.utils.mempool.
+    Mempool` — the reference allocates repo entries from parsec_mempool_t
+    for exactly this churn profile (one entry per produced task, retired
+    when all successors consumed)."""
 
     def __init__(self, nb_flows: int, name: str = "") -> None:
         self.nb_flows = nb_flows
         self.name = name
         self._table: Dict[Any, DataRepoEntry] = {}
         self._lock = threading.Lock()
+        from ..utils.mempool import Mempool
+        self._pool = Mempool(
+            factory=lambda: DataRepoEntry(self, None, nb_flows),
+            reset=self._scrub)
+
+    def _scrub(self, e: DataRepoEntry) -> None:
+        e.key = None
+        for i in range(self.nb_flows):
+            e.data[i] = None
+        e.usagelmt = 0
+        e.usagecnt = 0
+        e.retained = 0
 
     def lookup_entry(self, key: Any) -> Optional[DataRepoEntry]:
         with self._lock:
@@ -46,7 +64,8 @@ class DataRepo:
         with self._lock:
             e = self._table.get(key)
             if e is None:
-                e = DataRepoEntry(self, key, self.nb_flows)
+                e = self._pool.alloc()
+                e.key = key
                 self._table[key] = e
             e.retained += 1
             return e
@@ -82,6 +101,12 @@ class DataRepo:
         for copy in entry.data:
             if copy is not None and hasattr(copy, "release"):
                 copy.release()
+        # mempool return AFTER the copies dropped their references: the
+        # scrub clears the slots, and the shell re-enters circulation
+        self._pool.release(entry)
+
+    def pool_stats(self) -> Dict[str, int]:
+        return self._pool.stats()
 
     def __len__(self) -> int:
         return len(self._table)
