@@ -559,6 +559,36 @@ def main() -> None:
     log(f"POTRF correctness max err (256): {perr:.2e}")
     assert perr < 1e-2, f"POTRF correctness failed: {perr}"
 
+    # ---- 1D stencil GFLOP/s (the reference's stencil harness row,
+    # BASELINE.md: testing_stencil_1D.c reports gflops via FLOPS_STENCIL_1D)
+    try:
+        from parsec_tpu.data.matrix import TiledMatrix
+        from parsec_tpu.ops.stencil import (insert_stencil1d_tasks,
+                                            stencil_flops)
+        sn, sts, sit = (1 << 22, 1 << 18, 8) if on_tpu else (1 << 20,
+                                                             1 << 16, 8)
+        sA = TiledMatrix("stA", 1, sn, 1, sts)
+        sB = TiledMatrix("stB", 1, sn, 1, sts)
+        base = rng.standard_normal((1, sn)).astype(np.float32)
+        best_st = 0.0
+        for r in range(reps + 1):
+            sA.fill(lambda m, k: base[:, k*sts:(k+1)*sts])
+            sB.fill(lambda m, k: np.zeros((1, sts), np.float32))
+            stp = DTDTaskpool(ctx, f"stencil-{r}")
+            t0 = time.perf_counter()
+            insert_stencil1d_tasks(stp, sA, sB, iterations=sit)
+            stp.wait()
+            stp.close()
+            ctx.wait()
+            dt = time.perf_counter() - t0
+            if r:
+                best_st = max(best_st, stencil_flops(sn, sit) / dt / 1e9)
+        results["stencil1d_gflops"] = round(best_st, 2)
+        log(f"1D stencil n={sn} ts={sts} iters={sit}: {best_st:.2f} GFLOP/s")
+    except Exception as e:  # noqa: BLE001
+        log(f"stencil leg failed: {e}")
+    persist("after stencil")
+
     # ---- steady-state task throughput (BASELINE.md primary metric #2) -----
     # the reference's EP harness is a PTG program
     # (tests/runtime/scheduling/ep.jdf + main.c): an embarrassingly-parallel

@@ -210,15 +210,21 @@ class Context:
             self._taskpools[tp.taskpool_id] = tp
             self._active += 1
             first = self._active == 1
-        if first and not self._gc_held and mca.get("runtime_gc_defer", True):
-            self._gc_held = True
-            _gc_defer_acquire()
-            # crash-safety (VERDICT r4 weak #6): a context abandoned
-            # without fini() (exception paths, leaked contexts) must not
-            # leave process-wide GC thresholds stretched forever — the
-            # finalizer releases this context's hold when it is collected
-            import weakref
-            self._gc_finalizer = weakref.finalize(self, _gc_defer_release)
+        if first and mca.get("runtime_gc_defer", True):
+            # the hold + finalizer transition under _cv: racing a
+            # concurrent quiesce-release outside the lock could detach the
+            # WRONG finalizer and lose the crash-safety net
+            with self._cv:
+                if not self._gc_held:
+                    self._gc_held = True
+                    _gc_defer_acquire()
+                    # crash-safety (VERDICT r4 weak #6): a context
+                    # abandoned without fini() must not leave process-wide
+                    # GC thresholds stretched forever — the finalizer
+                    # releases this context's hold when it is collected
+                    import weakref
+                    self._gc_finalizer = weakref.finalize(
+                        self, _gc_defer_release)
         # taskpool keeps one pending action for the enqueue itself
         tp.addto_nb_pending_actions(1)
         if tp.on_enqueue is not None:
@@ -238,12 +244,19 @@ class Context:
                 self._active -= 1
             quiesced = self._active == 0
             self._cv.notify_all()
-        if quiesced and self._gc_held:
+        if quiesced:
+            self._release_gc_hold()
+
+    def _release_gc_hold(self) -> None:
+        with self._cv:
+            if not self._gc_held:
+                return
             self._gc_held = False
             fin = getattr(self, "_gc_finalizer", None)
+            self._gc_finalizer = None
             if fin is not None:
                 fin.detach()     # normal release: the safety net must not
-            _gc_defer_release()  # double-decrement the process refcount
+        _gc_defer_release()      # double-decrement the process refcount
 
     # ------------------------------------------------------------------ start/wait
     def start(self) -> None:
@@ -312,12 +325,7 @@ class Context:
         self.devices.fini()
         if self.comm is not None:
             self.comm.fini()
-        if self._gc_held:   # error paths can finalize with pools active
-            self._gc_held = False
-            fin = getattr(self, "_gc_finalizer", None)
-            if fin is not None:
-                fin.detach()
-            _gc_defer_release()
+        self._release_gc_hold()  # error paths can finalize w/ pools active
 
     # ------------------------------------------------------------------ scheduling
     def schedule(self, tasks, stream: Optional[ExecutionStream] = None,

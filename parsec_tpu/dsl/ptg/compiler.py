@@ -310,9 +310,14 @@ class PTGTaskpool(Taskpool):
                                       negate=True, dtt=d.dtt,
                                       dtt_remote=d.dtt_remote)
 
-        # hooks — flowless classes (the EP shape) skip the data hooks
-        # entirely instead of paying per-task env construction for nothing
-        tc.prepare_input = self._mk_prepare_input(tc) if tc.flows else None
+        # hooks — flowless AND CTL-only classes (the EP/control shapes)
+        # skip the data prepare hook entirely instead of paying per-task
+        # env construction for flows that carry no data (the generic
+        # prepare's CTL skip is a cheap loop; this one built an env first)
+        has_data_flows = any(not (f.access & FLOW_ACCESS_CTL)
+                             for f in tc.flows)
+        tc.prepare_input = self._mk_prepare_input(tc) if has_data_flows \
+            else None
         if any(getattr(f, "_ptg_mem_out", None) for f in tc.flows):
             tc.complete_execution = self._mk_complete(tc)
         nb_bodies = 0
@@ -592,12 +597,13 @@ class PTGTaskpool(Taskpool):
             oi += 1
 
     def _mk_cpu_hook(self, tc: TaskClass, fn):
-        if not tc.flows:
-            # flowless class (the EP/control-task shape): no arrays flow
-            # through the body, so the jit wrapper is pure dispatch
-            # overhead — run the raw python body
+        if all(f.access & FLOW_ACCESS_CTL for f in tc.flows):
+            # flowless or CTL-only class (the EP/control-task shapes): no
+            # arrays flow through the body, so the jit wrapper is pure
+            # dispatch overhead (~10us/call) — run the raw python body
             raw = getattr(fn, "__wrapped__", fn)
-            tc._ptg_raw_body = raw      # the agglomerated-sweep entry
+            if not tc.flows:
+                tc._ptg_raw_body = raw  # the agglomerated-sweep entry
 
             def flowless_hook(stream, task: Task) -> int:
                 raw(*[task.locals[p] for p in tc._ptg_spec.params])

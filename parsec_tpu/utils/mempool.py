@@ -120,10 +120,14 @@ class Mempool:
         if tp is None:
             return
         if tp.dead:
+            old = tp
             tp = self._my_pool()
             setattr(obj, self.owner_attr, tp)
+            old.constructed = max(0, old.constructed - 1)
+            tp.constructed += 1         # re-homed: the count moves with it
         if len(tp.free) >= tp.max_free:
-            return                      # overflow: let GC take it
+            tp.constructed = max(0, tp.constructed - 1)
+            return                      # overflow: dropped to GC, uncounted
         tp.free.append(obj)
 
     def stats(self) -> Dict[str, int]:
