@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Zone-allocator throughput microbench (ref:
+tests/runtime/cuda/zonemalloc_benchmark.c — the reference measures its GPU
+zone-malloc under random alloc/free churn; BASELINE.md lists the harness).
+
+Drives BOTH zone backends through the same randomized alloc/free trace —
+the pure-Python `utils/zone_malloc.ZoneMalloc` (the device-module heap
+manager) and the native C++ `pt_zone` via `native.NativeZone` — with a
+working set of live blocks, random sizes, random replacement; reports
+operations/second per backend. Prints one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def drive(alloc, free, n_ops: int, rng, max_live: int = 256,
+          max_bytes: int = 1 << 20) -> dict:
+    live = []
+    allocs = frees = failures = 0
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        if live and (len(live) >= max_live or rng.random() < 0.45):
+            ix = int(rng.integers(len(live)))
+            free(live.pop(ix))
+            frees += 1
+        else:
+            nb = int(rng.integers(1, max_bytes))
+            tok = alloc(nb)
+            if tok is None:
+                failures += 1
+                continue
+            live.append(tok)
+            allocs += 1
+    dt = time.perf_counter() - t0
+    for tok in live:
+        free(tok)
+    return {"ops_per_sec": round((allocs + frees) / dt),
+            "allocs": allocs, "frees": frees, "alloc_failures": failures,
+            "wall_s": round(dt, 4)}
+
+
+def main() -> None:
+    from parsec_tpu import native as native_mod
+    from parsec_tpu.utils.zone_malloc import ZoneMalloc
+
+    total, unit = 1 << 28, 1 << 12          # 256 MB heap, 4 KB units
+    n_ops = int(os.environ.get("ZONE_BENCH_OPS", "200000"))
+    out = {"metric": "zone-malloc-ops", "unit": "ops/s",
+           "heap_bytes": total, "unit_bytes": unit, "n_ops": n_ops}
+
+    pz = ZoneMalloc(total, unit=unit)
+    out["python"] = drive(lambda nb: pz.allocate(nb),
+                          lambda seg: pz.free(seg),
+                          n_ops, np.random.default_rng(11))
+    out["python"]["end_stats"] = pz.stats()
+
+    if native_mod.available():
+        nz = native_mod.NativeZone(total, unit=unit)
+
+        def nalloc(nb):
+            off = nz.alloc(nb)
+            return None if off is None else (off, nb)
+
+        out["native"] = drive(nalloc, lambda tok: nz.free(*tok),
+                              n_ops, np.random.default_rng(11))
+        out["native"]["end_stats"] = nz.stats()
+        out["value"] = out["native"]["ops_per_sec"]
+        out["native_vs_python"] = round(
+            out["native"]["ops_per_sec"]
+            / max(1, out["python"]["ops_per_sec"]), 2)
+    else:
+        out["value"] = out["python"]["ops_per_sec"]
+        out["native"] = None
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

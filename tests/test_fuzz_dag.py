@@ -94,13 +94,20 @@ def _insert_all(tp, tiles, tasks):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
-@pytest.mark.parametrize("mode", ["sched1", "sched4", "capture", "scan"])
+@pytest.mark.parametrize("mode", ["sched1", "sched1-py", "sched4",
+                                  "capture", "scan"])
 def test_fuzz_single_rank(seed, mode):
     """`scan` is the worst case for the task-class interpreter: random
     per-op scalar constants make nearly every op its own class, so the
-    switch is as wide as the DAG — correctness must survive anyway."""
+    switch is as wide as the DAG — correctness must survive anyway.
+    `sched1` exercises the NATIVE dependency engine; `sched1-py` forces
+    the Python engine on the same DAGs — a differential pair with the
+    numpy replay as the shared oracle."""
+    from parsec_tpu.utils import mca
     tasks = random_dag(seed)
     ref = numpy_replay(tasks, _init)
+    if mode == "sched1-py":
+        mca.set("native_enabled", False)
     ctx = Context(nb_cores=4 if mode == "sched4" else 1)
     try:
         A = TiledMatrix(f"F{mode}{seed}", NT * TS, TS, TS, TS)
@@ -117,8 +124,16 @@ def test_fuzz_single_rank(seed, mode):
             got = np.asarray(A.data_of(i, 0).newest_copy().payload)
             np.testing.assert_allclose(got, ref[i], rtol=1e-4, atol=1e-4,
                                        err_msg=f"tile {i} ({mode}, {seed})")
+        if mode == "sched1":
+            # the native lane must actually have engaged (guards the
+            # differential claim against silent fallbacks)
+            assert tp._neng is not None
+        elif mode == "sched1-py":
+            assert tp._neng is None
     finally:
         ctx.fini()
+        if mode == "sched1-py":
+            mca.params.unset("native_enabled")
 
 
 @pytest.mark.parametrize("seed", [0, 3])
