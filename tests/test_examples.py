@@ -8,7 +8,7 @@ import sys
 import pytest
 
 EXAMPLES = [f"ex0{i}" for i in range(9)] + ["ex10", "ex11", "ex12", "ex13",
-                                            "ex14", "ex15"]
+                                            "ex14", "ex15", "ex16"]
 EX_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                       "examples")
 
