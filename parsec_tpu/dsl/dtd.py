@@ -594,11 +594,11 @@ class DTDTaskpool(Taskpool):
                 # ready now — but insert_task is ASYNCHRONOUS by contract
                 # (bodies run at the window stall / wait drain, never at
                 # insert): batch toward the scheduler so priorities stay
-                # policy-visible while the push cost amortizes. The GIL
-                # makes the bare append safe against a concurrent flush's
-                # swap-under-lock (the append lands in whichever list the
-                # load observed; a swapped-out list is scheduled AFTER the
-                # append by the same lock)
+                # policy-visible while the push cost amortizes. The lock
+                # pairs the append with the flusher's swap — two USER
+                # threads may insert concurrently regardless of stream
+                # count, and an append racing the swap would land in an
+                # already-scheduled list
                 with self._exec_lock:
                     buf = self._ready_buf
                     buf.append(task)
