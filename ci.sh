@@ -11,6 +11,15 @@ cd "$(dirname "$0")"
 echo "== native build =="
 make -C native "PYTHON=$(command -v python3)"
 
+echo "== native artifacts must load (no silent pure-Python fallback) =="
+python3 - <<'EOF'
+from parsec_tpu import native
+assert native.available(), "libptcore.so built but failed to load"
+assert native.load_ptdtd() is not None, "_ptdtd built but failed to load"
+assert native.load_ptexec() is not None, "_ptexec built but failed to load"
+print("native artifacts OK (ptcore, ptdtd, ptexec)")
+EOF
+
 echo "== byte-compile lint (syntax over the whole tree) =="
 python3 -m compileall -q parsec_tpu tests examples benchmarks bench.py \
     __graft_entry__.py setup.py

@@ -86,7 +86,7 @@ PyObject *engine_tile(PyObject *obj, PyObject *) {
 }
 
 // insert(tile_ids: list|tuple[int], accs: list|tuple[int])
-//   -> (task_id, deps_remaining)   — deps_remaining == 0 means ready
+//   -> (task_id, deps_remaining)   — the insertion guard is STILL HELD
 //
 // Replicates dsl/dtd.py _link_tile single-rank semantics exactly:
 //   READ (or access without WRITE): RAW pred on the live last writer;
@@ -95,10 +95,15 @@ PyObject *engine_tile(PyObject *obj, PyObject *) {
 //   WRITE: WAR preds on live readers, WAW pred on the live last writer;
 //     the tile chain then points at this task and the reader list resets.
 // Preds are deduplicated (visit stamps) and self-edges skipped; each live
-// pred gains a successor edge and bumps this task's dep count. The
-// insertion guard (count starts at 1) drops at the end — "becomes ready
-// exactly once" (ref: parsec_dtd_schedule_task_if_ready,
-// insert_function.c:2963).
+// pred gains a successor edge and bumps this task's dep count.
+//
+// The insertion guard (count starts at 1) is NOT dropped here: the caller
+// must publish its id->task bookkeeping and then call activate(task_id),
+// which drops the guard — the count-then-activate protocol of
+// parsec_dtd_schedule_task_if_ready (insert_function.c:2963). Dropping
+// the guard inside insert() would let a fast predecessor completing on a
+// worker thread surface this id from complete() BEFORE the inserting
+// thread has mapped it (the round-5 activation race, ADVICE.md).
 PyObject *engine_insert(PyObject *obj, PyObject *args) {
     Engine *self = reinterpret_cast<Engine *>(obj);
     PyObject *tile_ids, *accs;
@@ -209,9 +214,29 @@ PyObject *engine_insert(PyObject *obj, PyObject *args) {
     }
 
     TaskRec &rec = tasks[(size_t)tid];
-    rec.deps_remaining += new_deps;
-    --rec.deps_remaining;                            // drop insertion guard
+    rec.deps_remaining += new_deps;                  // guard still held
     return Py_BuildValue("(Li)", (long long)tid, (int)rec.deps_remaining);
+}
+
+// activate(task_id) -> deps_remaining after dropping the insertion guard
+// (0 == ready NOW and the caller owns scheduling it; a concurrent
+// complete() can never have reported it). Call exactly once per insert,
+// AFTER the id->task map is populated.
+PyObject *engine_activate(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    int64_t tid = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return nullptr;
+    std::vector<TaskRec> &tasks = *self->tasks;
+    if (tid < 0 || (size_t)tid >= tasks.size()) {
+        PyErr_SetString(PyExc_IndexError, "bad task id");
+        return nullptr;
+    }
+    TaskRec &rec = tasks[(size_t)tid];
+    if (rec.completed) {
+        PyErr_SetString(PyExc_RuntimeError, "activate after completion");
+        return nullptr;
+    }
+    return PyLong_FromLong(--rec.deps_remaining);
 }
 
 // complete(task_id) -> tuple of newly-ready task ids (often empty)
@@ -305,7 +330,10 @@ PyMethodDef engine_methods[] = {
     {"tile", engine_tile, METH_NOARGS,
      "register a tile chain; returns its id"},
     {"insert", engine_insert, METH_VARARGS,
-     "insert(tile_ids, accs) -> (task_id, deps_remaining)"},
+     "insert(tile_ids, accs) -> (task_id, deps_remaining); the insertion "
+     "guard stays held until activate(task_id)"},
+    {"activate", engine_activate, METH_O,
+     "drop the insertion guard; returns deps remaining (0 = ready now)"},
     {"complete", engine_complete, METH_O,
      "complete(task_id) -> tuple of newly-ready task ids"},
     {"deps_remaining", engine_deps_remaining, METH_O,

@@ -1,0 +1,354 @@
+// parsec_tpu._ptexec — the generic task FSM as a CPython extension.
+//
+// Stands where the reference's generated-C PTG execute path stands
+// (the task FSM of parsec/scheduling.c:507-569 driven by generated
+// release_deps/iterate_successors, parsec/parsec.c:1837): dependency-count
+// decrement, ready-detect, dispatch, and successor release run inside ONE
+// C call per *batch* of tasks. The lesson applied here is the same one the
+// TPU ahead-of-time compilation line of work draws (arXiv:1810.09868):
+// lowering the whole CONTROL STRUCTURE out of the interpreted host
+// language — not just the task bodies — is where the order of magnitude
+// lives. The Python side (dsl/ptg/compiler.py) plays jdf2c: it flattens a
+// PTG taskpool's dependency structure into the CSR successor table this
+// engine consumes, once per (program, globals) shape.
+//
+// Concurrency contract: run() may be called from MANY Python threads on
+// the same Graph. The GIL is dropped for the whole FSM walk (ready-pop,
+// decrement, release) and re-acquired only to dispatch a batch of
+// non-empty task bodies through the Python callback — so for empty/CTL
+// task classes the walk is GIL-free end to end and Context(nb_cores>1)
+// in-process workers scale on real cores. Shared state is a small mutex
+// around the ready stack plus per-task atomic dependency counters; the
+// release decrement uses fetch_sub so two workers releasing into the same
+// successor can never double-ready it.
+//
+// run() never blocks waiting for work: a starved worker returns to the
+// Python hot loop (which has its own backoff and other task sources) and
+// comes back — the "burst handoff into/out of the lane".
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+struct Graph {
+    PyObject_HEAD
+    int64_t n;
+    std::vector<int32_t> *goals;     // initial dep count per task
+    std::vector<int32_t> *succ_off;  // CSR offsets, n+1 entries
+    std::vector<int32_t> *succs;     // flattened successor ids
+    std::vector<int32_t> *seeds;     // ids with goal 0
+    std::atomic<int32_t> *counts;    // remaining deps per task
+    std::mutex *mu;                  // guards ready/completed/running/error
+    std::vector<int32_t> *ready;     // LIFO work stack
+    int64_t completed;
+    int32_t running;                 // workers mid-batch
+    bool error;                      // a callback raised somewhere
+};
+
+bool parse_i32_list(PyObject *obj, std::vector<int32_t> &out,
+                    const char *what) {
+    PyObject *fast = PySequence_Fast(obj, what);
+    if (!fast) return false;
+    Py_ssize_t k = PySequence_Fast_GET_SIZE(fast);
+    out.resize((size_t)k);
+    PyObject **items = PySequence_Fast_ITEMS(fast);
+    for (Py_ssize_t i = 0; i < k; i++) {
+        long v = PyLong_AsLong(items[i]);
+        if (v == -1 && PyErr_Occurred()) { Py_DECREF(fast); return false; }
+        out[(size_t)i] = (int32_t)v;
+    }
+    Py_DECREF(fast);
+    return true;
+}
+
+void graph_reset_state(Graph *self) {
+    for (int64_t i = 0; i < self->n; i++)
+        self->counts[i].store((*self->goals)[(size_t)i],
+                              std::memory_order_relaxed);
+    *self->ready = *self->seeds;
+    self->completed = 0;
+    self->running = 0;
+    self->error = false;
+}
+
+PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
+    PyObject *goals_o, *off_o, *succs_o;
+    if (!PyArg_ParseTuple(args, "OOO", &goals_o, &off_o, &succs_o))
+        return nullptr;
+    Graph *self = reinterpret_cast<Graph *>(type->tp_alloc(type, 0));
+    if (!self) return nullptr;
+    self->goals = new (std::nothrow) std::vector<int32_t>();
+    self->succ_off = new (std::nothrow) std::vector<int32_t>();
+    self->succs = new (std::nothrow) std::vector<int32_t>();
+    self->seeds = new (std::nothrow) std::vector<int32_t>();
+    self->ready = new (std::nothrow) std::vector<int32_t>();
+    self->mu = new (std::nothrow) std::mutex();
+    self->counts = nullptr;
+    if (!self->goals || !self->succ_off || !self->succs || !self->seeds ||
+        !self->ready || !self->mu) {
+        Py_DECREF(self);
+        PyErr_NoMemory();
+        return nullptr;
+    }
+    if (!parse_i32_list(goals_o, *self->goals, "goals: sequence of ints") ||
+        !parse_i32_list(off_o, *self->succ_off, "succ_off: sequence of ints") ||
+        !parse_i32_list(succs_o, *self->succs, "succs: sequence of ints")) {
+        Py_DECREF(self);
+        return nullptr;
+    }
+    self->n = (int64_t)self->goals->size();
+    // structural validation once at build: run() then needs no bounds checks
+    if ((int64_t)self->succ_off->size() != self->n + 1) {
+        PyErr_SetString(PyExc_ValueError, "succ_off must have n+1 entries");
+        Py_DECREF(self);
+        return nullptr;
+    }
+    int32_t prev = 0;
+    for (int32_t o : *self->succ_off) {
+        if (o < prev || (size_t)o > self->succs->size()) {
+            PyErr_SetString(PyExc_ValueError, "succ_off not monotone in-range");
+            Py_DECREF(self);
+            return nullptr;
+        }
+        prev = o;
+    }
+    if (!self->succ_off->empty() &&
+        (size_t)self->succ_off->back() != self->succs->size()) {
+        PyErr_SetString(PyExc_ValueError, "succ_off must end at len(succs)");
+        Py_DECREF(self);
+        return nullptr;
+    }
+    for (int32_t s : *self->succs) {
+        if (s < 0 || (int64_t)s >= self->n) {
+            PyErr_SetString(PyExc_ValueError, "successor id out of range");
+            Py_DECREF(self);
+            return nullptr;
+        }
+    }
+    for (int64_t i = 0; i < self->n; i++) {
+        int32_t g = (*self->goals)[(size_t)i];
+        if (g < 0) {
+            PyErr_SetString(PyExc_ValueError, "negative goal");
+            Py_DECREF(self);
+            return nullptr;
+        }
+        if (g == 0) self->seeds->push_back((int32_t)i);
+    }
+    self->counts = new (std::nothrow) std::atomic<int32_t>[(size_t)self->n];
+    if (self->n && !self->counts) {
+        Py_DECREF(self);
+        PyErr_NoMemory();
+        return nullptr;
+    }
+    graph_reset_state(self);
+    return reinterpret_cast<PyObject *>(self);
+}
+
+void graph_dealloc(PyObject *obj) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    delete self->goals;
+    delete self->succ_off;
+    delete self->succs;
+    delete self->seeds;
+    delete self->ready;
+    delete self->mu;
+    delete[] self->counts;
+    Py_TYPE(obj)->tp_free(obj);
+}
+
+// reset() — rewind for replay of the same DAG shape (the cached-graph
+// reuse that makes a repeated instantiation cost a memcpy, not a rebuild).
+// Refused while any worker is mid-run.
+PyObject *graph_reset(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (self->running > 0) {
+            PyErr_SetString(PyExc_RuntimeError,
+                            "reset() while workers are running");
+            return nullptr;
+        }
+    }
+    graph_reset_state(self);
+    Py_RETURN_NONE;
+}
+
+// run(callback, batch, budget) -> number of tasks this caller executed.
+//
+//   callback: None for empty bodies (pure C walk), else a callable taking
+//             one list of ready task ids — it must run every body; the
+//             engine releases those tasks' successors only AFTER it
+//             returns (so an observer ordering recorded inside bodies
+//             always respects every release edge).
+//   batch:    max ids per callback call / per release sweep.
+//   budget:   return after executing >= budget tasks even if the graph is
+//             not finished (0 = run until starved or done). The caller's
+//             hot loop interleaves other work and re-enters.
+//
+// Returns promptly (never blocks) when the ready stack is empty; check
+// done() to distinguish "finished" from "starved while peers run".
+PyObject *graph_run(PyObject *obj, PyObject *args) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    PyObject *callback = Py_None;
+    int batch = 256;
+    long long budget = 0;
+    if (!PyArg_ParseTuple(args, "|OiL", &callback, &batch, &budget))
+        return nullptr;
+    if (batch <= 0) batch = 256;
+    if (callback != Py_None && !PyCallable_Check(callback)) {
+        PyErr_SetString(PyExc_TypeError, "callback must be callable or None");
+        return nullptr;
+    }
+    const int32_t *off = self->succ_off->data();
+    const int32_t *succ = self->succs->data();
+    std::vector<int32_t> local, fresh;
+    local.reserve((size_t)batch);
+    int64_t mine = 0;
+    PyThreadState *ts = PyEval_SaveThread();   // GIL dropped for the walk
+    for (;;) {
+        bool stop = false;
+        {
+            std::lock_guard<std::mutex> lk(*self->mu);
+            if (self->error || self->ready->empty()) {
+                stop = true;   // done, starved, or poisoned — caller decides
+            } else {
+                size_t take = std::min((size_t)batch, self->ready->size());
+                local.assign(self->ready->end() - (ptrdiff_t)take,
+                             self->ready->end());
+                self->ready->resize(self->ready->size() - take);
+                self->running++;
+            }
+        }
+        if (stop) break;
+        if (callback != Py_None) {
+            PyEval_RestoreThread(ts);
+            ts = nullptr;
+            PyObject *ids = PyList_New((Py_ssize_t)local.size());
+            if (ids) {
+                for (size_t i = 0; i < local.size(); i++)
+                    PyList_SET_ITEM(ids, (Py_ssize_t)i,
+                                    PyLong_FromLong(local[i]));
+                PyObject *r = PyObject_CallFunctionObjArgs(callback, ids,
+                                                           nullptr);
+                Py_DECREF(ids);
+                Py_XDECREF(r);
+                if (!r) ids = nullptr;   // reuse as the error marker
+            }
+            if (!ids) {
+                // a body raised: poison the graph so peers stop pulling
+                // work, undo our in-flight claim, propagate the exception
+                std::lock_guard<std::mutex> lk(*self->mu);
+                self->error = true;
+                self->running--;
+                return nullptr;
+            }
+            ts = PyEval_SaveThread();
+        }
+        fresh.clear();
+        for (int32_t t : local) {
+            for (int32_t k = off[t]; k < off[t + 1]; k++) {
+                int32_t s = succ[k];
+                if (self->counts[s].fetch_sub(
+                        1, std::memory_order_acq_rel) == 1)
+                    fresh.push_back(s);
+            }
+        }
+        {
+            std::lock_guard<std::mutex> lk(*self->mu);
+            self->completed += (int64_t)local.size();
+            self->running--;
+            if (!fresh.empty())
+                self->ready->insert(self->ready->end(), fresh.begin(),
+                                    fresh.end());
+        }
+        mine += (int64_t)local.size();
+        local.clear();
+        if (budget > 0 && mine >= budget) break;
+    }
+    if (ts) PyEval_RestoreThread(ts);
+    return PyLong_FromLongLong(mine);
+}
+
+PyObject *graph_done(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (!self->error && self->completed == self->n &&
+        self->ready->empty() && self->running == 0)
+        Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+PyObject *graph_failed(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (self->error) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+PyObject *graph_pending(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    return PyLong_FromLongLong(self->n - self->completed);
+}
+
+PyObject *graph_size(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    return Py_BuildValue("(Ln)", (long long)self->n,
+                         (Py_ssize_t)self->succs->size());
+}
+
+PyMethodDef graph_methods[] = {
+    {"run", graph_run, METH_VARARGS,
+     "run(callback=None, batch=256, budget=0) -> tasks executed by this call"},
+    {"reset", graph_reset, METH_NOARGS,
+     "rewind dependency counters and the ready stack for a replay"},
+    {"done", graph_done, METH_NOARGS,
+     "True when every task executed (and no error poisoned the run)"},
+    {"failed", graph_failed, METH_NOARGS,
+     "True when a body callback raised and poisoned the run"},
+    {"pending", graph_pending, METH_NOARGS,
+     "tasks not yet executed"},
+    {"size", graph_size, METH_NOARGS,
+     "(n_tasks, n_edges)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject GraphType = [] {
+    PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+    t.tp_name = "parsec_tpu._ptexec.Graph";
+    t.tp_basicsize = sizeof(Graph);
+    t.tp_flags = Py_TPFLAGS_DEFAULT;
+    t.tp_doc = "flattened task graph executed by the native FSM lane";
+    t.tp_new = graph_new;
+    t.tp_dealloc = graph_dealloc;
+    t.tp_methods = graph_methods;
+    return t;
+}();
+
+PyModuleDef ptexec_module = {
+    PyModuleDef_HEAD_INIT, "_ptexec",
+    "native PTG execution lane (see native/src/ptexec.cpp)", -1,
+    nullptr, nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__ptexec(void) {
+    if (PyType_Ready(&GraphType) < 0) return nullptr;
+    PyObject *m = PyModule_Create(&ptexec_module);
+    if (!m) return nullptr;
+    Py_INCREF(&GraphType);
+    if (PyModule_AddObject(m, "Graph",
+                           reinterpret_cast<PyObject *>(&GraphType)) < 0) {
+        Py_DECREF(&GraphType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

@@ -60,20 +60,68 @@ _KIND_BYE = 3        # clean shutdown notice (fini) — EOF after this is
                      # a normal departure, EOF without it is a FAILURE
 
 
-def _is_transport_error(exc: Exception) -> bool:
-    """Is this failure the PEER's (connection/transfer plane) rather than a
-    local fault? OSError covers the socket family (ConnectionError,
-    timeouts); PJRT transfer-plane failures surface as backend RuntimeErrors
-    whose messages carry transport markers rather than a local error class
-    like RESOURCE_EXHAUSTED (which is the consumer's own OOM)."""
+#: markers that only the PJRT transfer plane emits (gRPC status words and
+#: the transfer-server prefix) — strong enough to attribute on sight
+_TRANSPORT_STRONG = ("TRANSFER SERVER", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+                     "FAILED TO CONNECT", "CONNECTION REFUSED",
+                     "UNREACHABLE", "SOCKET")
+#: words that ALSO occur in ordinary local errors ("buffer reset",
+#: "stream closed", ...) — ambiguous, never trusted on a single failure
+_TRANSPORT_WEAK = ("CONNECT", "PEER", "CLOSED", "RESET", "REFUSED",
+                   "DEADLINE")
+
+
+def classify_transport_error(exc: Exception) -> str:
+    """Attribute a failure: ``"transport"`` (the PEER's connection/transfer
+    plane), ``"local"`` (this rank's own fault), or ``"ambiguous"``.
+
+    Typed checks first: the socket family (OSError covers ConnectionError
+    and timeouts) IS the transport. PJRT transfer-plane failures surface
+    as backend RuntimeErrors; only messages carrying markers unique to
+    that plane are attributed outright — a local RuntimeError that merely
+    *mentions* RESET is ambiguous at most, and callers must retry once
+    before acting on it (ADVICE.md r5: substring matching alone let a
+    local error mark a live peer dead)."""
     if isinstance(exc, (OSError, TimeoutError, EOFError)):
-        return True
+        return "transport"
     msg = str(exc).upper()
     if "RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg:
-        return False
-    return any(m in msg for m in (
-        "CONNECT", "UNAVAILABLE", "DEADLINE", "SOCKET", "TRANSFER SERVER",
-        "PEER", "CLOSED", "RESET", "REFUSED", "UNREACHABLE"))
+        return "local"       # the consumer's own OOM, never the wire
+    if not isinstance(exc, RuntimeError):
+        return "local"       # PJRT surfaces transfer faults as RuntimeError
+    if any(m in msg for m in _TRANSPORT_STRONG):
+        return "transport"
+    if any(m in msg for m in _TRANSPORT_WEAK):
+        return "ambiguous"
+    return "local"
+
+
+def _attributed_pull(pull_fn, ref):
+    """Run ``pull_fn(ref)`` with failure attribution. Returns
+    ``("ok", payload)`` or ``("transport", exc)``; local faults raise.
+
+    Ambiguous failures retry ONCE: a transient wire hiccup succeeds the
+    second time; a deterministic local error that happens to contain a
+    weak marker raises (the peer stays alive — real peer death is also
+    caught by the socket EOF/BYE paths, so under-attributing here is
+    safe while over-attributing silently drops a payload)."""
+    try:
+        return "ok", pull_fn(ref)
+    except Exception as exc:  # noqa: BLE001 — classified below
+        verdict = classify_transport_error(exc)
+        if verdict == "local":
+            raise
+        if verdict == "transport":
+            return "transport", exc
+        output.debug_verbose(1, "tcp",
+                             f"ambiguous pull failure "
+                             f"({type(exc).__name__}: {exc}); retrying once")
+        try:
+            return "ok", pull_fn(ref)
+        except Exception as exc2:  # noqa: BLE001
+            if classify_transport_error(exc2) == "transport":
+                return "transport", exc2
+            raise               # twice-ambiguous/local: this rank's problem
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, obj,
@@ -393,16 +441,18 @@ class TCPCE(CommEngine):
                 ref = payload
                 if self._xpull is None:     # pull-only handle: servicing a
                     self._xpull = XHostTransfer()   # peer does NOT enable
-                try:                                # our own send path
-                    payload = self._xpull.pull(ref)
-                except Exception as exc:
-                    # only TRANSPORT-shaped failures mean the producer is
-                    # gone (crashed before the pull / transfer server
-                    # unreachable) — those are attributed like the BYE/EOF
-                    # paths. A local fault (consumer OOM, bad ref) must not
-                    # blame a live peer; it propagates as this rank's error.
-                    if not _is_transport_error(exc):
-                        raise
+                # only TRANSPORT-attributed failures mean the producer is
+                # gone (crashed before the pull / transfer server
+                # unreachable) — those are attributed like the BYE/EOF
+                # paths. A local fault (consumer OOM, bad ref) must not
+                # blame a live peer; it propagates as this rank's error,
+                # and ambiguous failures get one retry before either
+                # (typed classification + retry, ADVICE.md r5)
+                status, got = _attributed_pull(self._xpull.pull, ref)
+                if status == "ok":
+                    payload = got
+                else:
+                    exc = got
                     output.warning(
                         f"tcp: xhost pull from rank {src} failed "
                         f"({type(exc).__name__}: {exc}); marking peer dead")

@@ -175,6 +175,11 @@ class Context:
         # (RemoteDepEngine flips this when it attaches)
         self._need_wake = self.nb_cores > 1
         self._gc_held = False
+        #: native PTG execution lanes awaiting drain: [(taskpool, lane)].
+        #: Every stream's hot loop joins the front graph's run() (the C
+        #: walk is GIL-free, so in-process workers scale on real cores)
+        self._ptexec_q: List = []
+        self._ptexec_lock = threading.Lock()
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
 
@@ -374,6 +379,68 @@ class Context:
         # threads (user code, comm thread) act as the master stream
         return getattr(self._tls, "stream", None) or self.streams[0]
 
+    # ------------------------------------------------------------ native lane
+    def _ptexec_enqueue(self, tp: Taskpool, lane: Dict[str, Any]) -> None:
+        """A PTG taskpool handed its whole FSM to the native execution
+        lane (dsl/ptg/compiler.py _ptexec_prepare); every stream's hot
+        loop drains it."""
+        with self._ptexec_lock:
+            self._ptexec_q.append((tp, lane))
+        self._work_event.set()
+
+    def _ptexec_drain(self, stream: ExecutionStream) -> bool:
+        """One burst through the front lane graph. The burst budget shrinks
+        when this stream's scheduler queues hold work so a live lane cannot
+        starve concurrently-active taskpools; the graph's run() never
+        blocks, so a starved call returns straight to the hot loop."""
+        with self._ptexec_lock:
+            if not self._ptexec_q:
+                return False
+            tp, lane = self._ptexec_q[0]
+        graph = lane["graph"]
+        # short bursts whenever (a) ordinary queues hold work, or (b) the
+        # lane dispatches eager Python bodies — a body-callback burst is
+        # bounded in TASK count, not time, so a long budget would blind
+        # this stream to newly scheduled tasks and peer errors for the
+        # whole burst. Empty-body walks run >10M tasks/s, so the long
+        # budget still returns within ~0.5s
+        if lane["callback"] is not None or self.sched.has_local_work(stream):
+            budget = 4096
+        else:
+            budget = 1 << 22
+        try:
+            mine = graph.run(lane["callback"], 256, budget)
+        except BaseException as e:  # noqa: BLE001 — a body raised
+            with self._ptexec_lock:
+                if self._ptexec_q and self._ptexec_q[0][1] is lane:
+                    self._ptexec_q.pop(0)
+            if self._error is None:
+                self._error = e
+            self._work_event.set()
+            if stream.is_master:
+                raise           # workers park; the master surfaces the error
+            return True
+        stream.nb_executed += mine
+        if graph.failed():
+            # poisoned by another stream's body exception: that stream
+            # owns the propagation; just retire the queue entry
+            with self._ptexec_lock:
+                if self._ptexec_q and self._ptexec_q[0][1] is lane:
+                    self._ptexec_q.pop(0)
+            return True
+        if graph.done():
+            fin = False
+            with self._ptexec_lock:
+                if not lane.get("finalized"):
+                    lane["finalized"] = True
+                    fin = True
+                if self._ptexec_q and self._ptexec_q[0][1] is lane:
+                    self._ptexec_q.pop(0)
+            if fin:
+                tp._ptexec_finalize(lane)
+            return True
+        return mine > 0
+
 
     # ------------------------------------------------------------------ hot loop
     def _worker_main(self, stream: ExecutionStream) -> None:
@@ -404,6 +471,10 @@ class Context:
                 did_something |= bool(self.comm.progress())
             # poll device modules (our analogue of the GPU manager thread)
             did_something |= bool(self.devices.progress(stream))
+            # native PTG execution lane: join the front graph's batched C
+            # walk (returns promptly when starved — see _ptexec_drain)
+            if self._ptexec_q:
+                did_something |= self._ptexec_drain(stream)
             task = stream.next_task
             stream.next_task = None
             distance = 0

@@ -81,6 +81,14 @@ class SchedulerModule:
     def stats(self, stream) -> Dict[str, int]:
         return {}
 
+    def has_local_work(self, stream) -> bool:
+        """Cheap peek: does this stream see queued tasks without popping?
+        The native execution lane (core/context.py:_ptexec_drain) sizes
+        its bursts by this — a live lane must interleave with, not starve,
+        taskpools riding the ordinary queues. False negatives only cost
+        one long burst; the default is safe for modules without queues."""
+        return False
+
     def remove(self, context) -> None:
         pass
 
@@ -333,6 +341,9 @@ class _LocalQueuesBase(SchedulerModule):
     def stats(self, stream):
         return {"local_len": len(self._local(stream)),
                 "system_len": len(self._system)}
+
+    def has_local_work(self, stream) -> bool:
+        return bool(len(self._local(stream)) or len(self._system))
 
 
 # ---------------------------------------------------------------------------
@@ -588,6 +599,9 @@ class _LockedHeapList:
                 return half
             return self.heaps.pop(best)   # singleton: take it whole
 
+    def __len__(self) -> int:
+        return len(self.heaps)
+
 
 class SchedLL(_LocalQueuesBase):
     """Local LIFO: push and pop the same end (depth-first), steal the other
@@ -681,6 +695,9 @@ class _GlobalBase(SchedulerModule):
     def flow_init(self, stream) -> None:
         pass
 
+    def has_local_work(self, stream) -> bool:
+        return len(self._q) > 0
+
 
 class SchedGD(_GlobalBase):
     """Global dequeue (ref: sched_gd)."""
@@ -733,6 +750,9 @@ class _GlobalHeapBase(SchedulerModule):
 
     def flow_init(self, stream) -> None:
         pass
+
+    def has_local_work(self, stream) -> bool:
+        return len(self._heap) > 0
 
     def schedule(self, stream, tasks, distance: int = 0) -> None:
         for t in tasks:

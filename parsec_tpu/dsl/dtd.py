@@ -584,13 +584,21 @@ class DTDTaskpool(Taskpool):
                         tile.compact_at = max(32, 2 * len(live))
                     else:
                         readers.append(task)
-            tid, ndeps = neng.insert(nids, naccs)
+            # count-then-activate (ref: parsec_dtd_schedule_task_if_ready,
+            # insert_function.c:2963): insert() links the chains but KEEPS
+            # the insertion guard held, so a fast predecessor completing on
+            # a worker thread cannot surface this id from complete() before
+            # the id->task map below is populated (the round-5 activation
+            # race, ADVICE.md). activate() drops the guard only after the
+            # task is findable.
+            tid, _held = neng.insert(nids, naccs)
             task.nid = tid
-            task.deps_remaining = ndeps
             self.ctx._dtd_ntasks[tid] = task
             self.addto_nb_tasks(1)
             li = self.local_inserted = self.local_inserted + 1
+            ndeps = neng.activate(tid)
             if ndeps == 0:
+                task.deps_remaining = 0
                 # ready now — but insert_task is ASYNCHRONOUS by contract
                 # (bodies run at the window stall / wait drain, never at
                 # insert): batch toward the scheduler so priorities stay

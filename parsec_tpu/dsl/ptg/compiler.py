@@ -47,6 +47,13 @@ mca.register("ptg_agglomerate", True,
              "Execute statically-independent flowless PTG classes "
              "as one fused sweep at startup (no per-task "
              "scheduling cycle)", type=bool)
+mca.register("ptg_native_exec", True,
+             "Drain eligible PTG taskpools (CTL/empty-body or eager "
+             "CPU-body classes) through the native execution lane "
+             "(native/src/ptexec.cpp): the full dependency FSM runs "
+             "batched in C with the GIL dropped. Ineligible pools "
+             "fall back to the Python FSM (docs/native_exec.md)",
+             type=bool)
 
 _ACCESS_MAP = {
     P.FLOW_READ: FLOW_ACCESS_READ,
@@ -163,6 +170,8 @@ class PTGTaskpool(Taskpool):
         #: producer (consumed by prepare_input)
         self._ptg_received: Dict[Tuple, Any] = {}
         self._ptg_lock = threading.Lock()
+        #: native execution lane state (set by _startup when eligible)
+        self._ptexec_state: Optional[Dict[str, Any]] = None
         self._build()
         if ctx.comm is not None and ctx.nb_ranks > 1:
             # distributed PTG: global termination + name-keyed routing
@@ -602,8 +611,10 @@ class PTGTaskpool(Taskpool):
             # arrays flow through the body, so the jit wrapper is pure
             # dispatch overhead (~10us/call) — run the raw python body
             raw = getattr(fn, "__wrapped__", fn)
-            if not tc.flows:
-                tc._ptg_raw_body = raw  # the agglomerated-sweep entry
+            # the agglomerated-sweep entry (flowless) and the native
+            # execution lane's batched-dispatch entry (CTL-only) both
+            # call the raw body with the class parameters
+            tc._ptg_raw_body = raw
 
             def flowless_hook(stream, task: Task) -> int:
                 raw(*[task.locals[p] for p in tc._ptg_spec.params])
@@ -830,6 +841,197 @@ class PTGTaskpool(Taskpool):
         stream.nb_executed += n
         return n
 
+    # ------------------------------------------------------- native exec lane
+    def _ptexec_class_eligible(self, tc: TaskClass) -> bool:
+        """May this class's whole FSM run inside the native lane
+        (native/src/ptexec.cpp)?  Eligibility = the per-task cycle carries
+        no state the lane does not model: control-only flows (no data, no
+        repos, no reshapes), exactly one ungated CPU chore (bodies are
+        either empty or eager host Python dispatched via the batched
+        callback), no custom startup seeding, and no priority policy (the
+        lane's release order is edge-respecting, not priority-ordered)."""
+        if any(not (f.access & FLOW_ACCESS_CTL) for f in tc.flows):
+            return False
+        if getattr(tc, "_ptg_startup_fn", None) is not None:
+            return False
+        if "priority" in tc.properties:
+            return False
+        if len(tc.incarnations) != 1 or \
+                tc.incarnations[0].device_type != DEV_CPU or \
+                tc.incarnations[0].evaluate is not None:
+            return False
+        if len(tc._ptg_spec.bodies) != 1:
+            return False
+        # non-empty bodies dispatch through the raw-body callback
+        if tc._ptg_spec.bodies[0].source.strip() not in ("", "pass") and \
+                getattr(tc, "_ptg_raw_body", None) is None:
+            return False
+        return True
+
+    #: the builtins __init__ injects into env_base — identical in every
+    #: instantiation, so they never enter the cache signature. Matched by
+    #: IDENTITY: a user global that shadows one of these names is real
+    #: state and must poison the cache key instead.
+    _PTEXEC_SAFE_ENV = {"min": min, "max": max, "abs": abs, "range": range,
+                        "len": len, "int": int, "divmod": divmod}
+
+    def _ptexec_cache_key(self, names: Tuple[str, ...]):
+        """Cache signature for the flattened graph: the task space and the
+        edge structure depend only on the program text and the globals the
+        range/guard/index expressions read. Non-primitive globals (incl.
+        user callables a guard might invoke) make the instantiation
+        uncacheable — flatten still runs, per pool."""
+        sig = []
+        for k, v in self.env_base.items():
+            if k == "__builtins__" or self._PTEXEC_SAFE_ENV.get(k) is v:
+                continue
+            if v is None or isinstance(v, (int, float, str, bool)):
+                sig.append((k, v))
+            else:
+                return None
+        return (tuple(sorted(sig)), names)
+
+    def _ptexec_flatten(self, classes: List[TaskClass]):
+        """Emit the flattened successor table the native lane consumes
+        (the jdf2c moment: the whole control structure leaves Python).
+        Returns None when the declared in/out dep sides disagree — the
+        Python FSM would mask one-sided declarations differently, so the
+        lane refuses rather than diverge."""
+        id_of: Dict[Tuple[int, Tuple[int, ...]], int] = {}
+        params_by_class: List[List[Tuple[int, ...]]] = []
+        bases: List[int] = []
+        n = 0
+        for ci, tc in enumerate(classes):
+            params = tc._ptg_spec.params
+            insts = [tuple(loc[p] for p in params)
+                     for loc in self._enum_class(tc)]
+            bases.append(n)
+            params_by_class.append(insts)
+            for key in insts:
+                id_of[(ci, key)] = n
+                n += 1
+        class_index = {tc._ptg_spec.name: ci
+                       for ci, tc in enumerate(classes)}
+        goals = [0] * n
+        edges: List[List[int]] = [[] for _ in range(n)]
+        indeg = [0] * n
+        for ci, tc in enumerate(classes):
+            params = tc._ptg_spec.params
+            # replay the param tuples materialized above instead of
+            # re-walking the range expressions (halves flatten latency)
+            for key in params_by_class[ci]:
+                loc = dict(zip(params, key))
+                my_id = id_of[(ci, key)]
+                goals[my_id] = tc.dependencies_goal_fn(loc)
+                for flow in tc.flows:
+                    for dep in flow.deps_out:
+                        if dep.task_class is None:
+                            continue
+                        if dep.cond is not None and not dep.cond(loc):
+                            continue
+                        si = class_index.get(dep.task_class.name)
+                        if si is None:
+                            return None     # edge into a non-lane class
+                        sparams = classes[si]._ptg_spec.params
+                        targets = dep.target_locals(loc) \
+                            if dep.target_locals else [loc]
+                        if isinstance(targets, dict):
+                            targets = [targets]
+                        for tl in targets:
+                            sid = id_of.get(
+                                (si, tuple(tl[p] for p in sparams)))
+                            if sid is None:
+                                return None  # successor outside the space
+                            edges[my_id].append(sid)
+                            indeg[sid] += 1
+        if indeg != goals:
+            # producer-declared edges and consumer-declared goals disagree
+            output.debug_verbose(1, "ptg",
+                                 f"{self.name}: native lane refused "
+                                 f"(in-dep goals != out-dep edges)")
+            return None
+        off = [0] * (n + 1)
+        for i, e in enumerate(edges):
+            off[i + 1] = off[i] + len(e)
+        succs: List[int] = []
+        for e in edges:
+            succs.extend(e)
+        return {"n": n, "goals": goals, "off": off, "succs": succs,
+                "bases": bases, "params": params_by_class}
+
+    def _ptexec_prepare(self, agg) -> Optional[Dict[str, Any]]:
+        """Build (or fetch from the program cache) the native-lane state
+        for this pool, or None → the Python FSM runs as before. The fall
+        back is per-pool: one ineligible class keeps cross-class release
+        edges in Python, so the whole pool stays there."""
+        ctx = self.ctx
+        if (not mca.get("ptg_native_exec", True) or ctx.nb_ranks > 1
+                or ctx.comm is not None or ctx.pins.enabled or ctx.paranoid):
+            return None
+        classes = [self._classes[tcs.name]
+                   for tcs in self.program.spec.task_classes
+                   if tcs.name not in agg]
+        if not classes:
+            return None
+        for tc in classes:
+            if not self._ptexec_class_eligible(tc):
+                return None
+        from ... import native as native_mod
+        mod = native_mod.load_ptexec()
+        if mod is None:
+            return None
+        names = tuple(tc._ptg_spec.name for tc in classes)
+        key = self._ptexec_cache_key(names)
+        cache = self.program.__dict__.setdefault("_ptexec_cache", {})
+        flat = cache.get(key) if key is not None else None
+        if flat is None:
+            flat = self._ptexec_flatten(classes)
+            if flat is None:
+                return None
+            if key is not None:
+                cache[key] = flat
+        if flat["n"] == 0:
+            return {"n": 0}
+        # the CSR (the expensive flatten) is shared across instantiations;
+        # the Graph (counters + ready state + ~1ms of list parsing) is
+        # built fresh PER POOL — a stream holding a stale drain-queue entry
+        # can then never walk another pool's tasks, and bodies/callbacks
+        # (which resolve against THIS instantiation's globals) can never
+        # cross pools. Empty bodies dispatch nothing at all.
+        graph = mod.Graph(flat["goals"], flat["off"], flat["succs"])
+        bodies = [None if tc._ptg_spec.bodies[0].source.strip()
+                  in ("", "pass") else tc._ptg_raw_body for tc in classes]
+        callback = None
+        if any(b is not None for b in bodies):
+            callback = self._mk_ptexec_callback(flat["bases"], bodies,
+                                                flat["params"])
+        return {"graph": graph, "callback": callback,
+                "n": flat["n"], "finalized": False}
+
+    def _mk_ptexec_callback(self, bases: List[int], bodies,
+                            params_by_class):
+        """Batched body dispatch: the engine hands over a list of ready
+        task ids; every body must run before it returns (successor release
+        happens after, preserving release-edge ordering for observers)."""
+        import bisect as _bisect
+        def run_batch(ids):
+            for i in ids:
+                k = _bisect.bisect_right(bases, i) - 1
+                fn = bodies[k]
+                if fn is not None:
+                    fn(*params_by_class[k][i - bases[k]])
+        return run_batch
+
+    def _ptexec_finalize(self, lane: Dict[str, Any]) -> None:
+        """Called exactly once (by whichever stream drains the graph last)
+        after every lane task executed: retire the task accounting in one
+        step — the per-task complete/release cycle already ran in C."""
+        output.debug_verbose(2, "ptg",
+                             f"{self.name}: native lane retired "
+                             f"{lane['n']} tasks")
+        self.addto_nb_tasks(-lane["n"])
+
+    # ------------------------------------------------------------------ startup
     def _startup(self, stream, tp) -> List[Task]:
         total = 0
         ready: List[Task] = []
@@ -841,6 +1043,16 @@ class PTGTaskpool(Taskpool):
         for name in agg:
             self._agglomerated += self._run_agglomerated(
                 stream, self._classes[name])
+        lane = self._ptexec_prepare(agg)
+        if lane is not None:
+            self._ptexec_state = lane
+            self.set_nb_tasks(lane["n"])
+            if lane["n"]:
+                self.ctx._ptexec_enqueue(self, lane)
+            output.debug_verbose(2, "ptg",
+                                 f"{self.name}: {lane['n']} tasks on the "
+                                 f"native execution lane")
+            return []
         for tcs in self.program.spec.task_classes:
             if tcs.name in agg:
                 continue        # executed above, never scheduled/counted

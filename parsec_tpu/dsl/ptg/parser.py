@@ -146,8 +146,6 @@ _RE_RANGE = re.compile(r"^(\w+)\s*=\s*(.+?)\s*\.\.\s*(.+?)(?:\s*\.\.\s*(.+?))?\s
 _RE_AFFINITY = re.compile(r"^:\s*(\w+)\s*\(([^)]*)\)\s*$")
 _RE_PROPERTY = re.compile(r"^(\w+)\s*=\s*(.+)$")
 _RE_BODY = re.compile(r"^BODY(?:\s*\[([^\]]*)\])?\s*$")
-_RE_ENDPOINT_TASK = re.compile(r"^(\w+)\s+(\w+)\s*\(([^)]*)\)\s*$")
-_RE_ENDPOINT_MEM = re.compile(r"^(\w+)\s*\(([^)]*)\)\s*$")
 
 
 def _strip_comment(line: str) -> str:
@@ -156,21 +154,44 @@ def _strip_comment(line: str) -> str:
     return line[:idx] if idx >= 0 else line
 
 
+def _match_call(text: str) -> Optional[Tuple[str, str]]:
+    """``NAME(exprs)`` with BALANCED parens -> (name, inner) or None.
+    The old regex form ``\\(([^)]*)\\)`` broke on nested parentheses in
+    index expressions (e.g. ``T(((a*i+b) % N), 0)``); endpoints accept
+    the same nesting the expression splitter already does."""
+    m = re.match(r"^(\w+)\s*\(", text)
+    if not m:
+        return None
+    depth, start = 0, m.end() - 1
+    for i in range(start, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                if text[i + 1:].strip():
+                    return None          # trailing junk after the call
+                return m.group(1), text[start + 1:i]
+    return None                          # unbalanced
+
+
 def _parse_endpoint(text: str, line_no: int, line: str) -> Endpoint:
     text = text.strip()
     if text == "NEW":
         return Endpoint("new")
     if text == "NULL":
         return Endpoint("null")
-    m = _RE_ENDPOINT_TASK.match(text)
-    if m and m.group(1) not in ("",):
-        # "X T(k-1)" — flow then class
-        return Endpoint("task", name=m.group(2), flow=m.group(1),
-                        index_exprs=_split_exprs(m.group(3)))
-    m = _RE_ENDPOINT_MEM.match(text)
-    if m:
-        return Endpoint("memory", name=m.group(1),
-                        index_exprs=_split_exprs(m.group(2)))
+    parts = text.split(None, 1)
+    if len(parts) == 2 and re.fullmatch(r"\w+", parts[0]):
+        call = _match_call(parts[1])
+        if call is not None:
+            # "X T(k-1)" — flow then class
+            return Endpoint("task", name=call[0], flow=parts[0],
+                            index_exprs=_split_exprs(call[1]))
+    call = _match_call(text)
+    if call is not None:
+        return Endpoint("memory", name=call[0],
+                        index_exprs=_split_exprs(call[1]))
     raise PTGSyntaxError(f"cannot parse dependency endpoint {text!r}",
                          line_no, line)
 

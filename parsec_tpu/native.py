@@ -121,6 +121,42 @@ def available() -> bool:
 
 
 _ptdtd_mod = [None, False]   # [module, attempted]
+_ptexec_mod = [None, False]
+
+
+def _load_pyext(stem: str, cache):
+    """Load a CPython extension (built by native/Makefile or installed in
+    the wheel), memoized in ``cache`` ([module, attempted])."""
+    if cache[1]:
+        return cache[0]
+    with _lib_lock:
+        if cache[1]:
+            return cache[0]
+        cache[1] = True
+        if not mca.get("native_enabled", True):
+            return None
+        import importlib.util
+        import sysconfig
+        # installed wheel first; else the in-tree build. Exact ABI-tagged
+        # filename of the RUNNING interpreter — a wildcard could load a
+        # stale extension built against another Python
+        so = _installed_so(stem)
+        if so is None:
+            so = os.path.join(_NATIVE_DIR, "build",
+                              stem + sysconfig.get_config_var("EXT_SUFFIX"))
+            if not os.path.exists(so) and not (_build()
+                                               and os.path.exists(so)):
+                return None
+        try:
+            spec = importlib.util.spec_from_file_location(
+                f"parsec_tpu.{stem}", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            cache[0] = mod
+            output.debug_verbose(1, "native", f"{stem} loaded from {so}")
+        except Exception as e:  # noqa: BLE001
+            output.debug_verbose(1, "native", f"{stem} load failed: {e}")
+        return cache[0]
 
 
 def load_ptdtd():
@@ -130,36 +166,16 @@ def load_ptdtd():
     C-extension call costs (~0.2us) — the ctypes boundary (~2us) that the
     coarse bindings above tolerate would eat the entire win (module
     docstring)."""
-    if _ptdtd_mod[1]:
-        return _ptdtd_mod[0]
-    with _lib_lock:
-        if _ptdtd_mod[1]:
-            return _ptdtd_mod[0]
-        _ptdtd_mod[1] = True
-        if not mca.get("native_enabled", True):
-            return None
-        import importlib.util
-        import sysconfig
-        # installed wheel first; else the in-tree build. Exact ABI-tagged
-        # filename of the RUNNING interpreter — a wildcard could load a
-        # stale extension built against another Python
-        so = _installed_so("_ptdtd")
-        if so is None:
-            so = os.path.join(_NATIVE_DIR, "build",
-                              "_ptdtd" + sysconfig.get_config_var("EXT_SUFFIX"))
-            if not os.path.exists(so) and not (_build()
-                                               and os.path.exists(so)):
-                return None
-        try:
-            spec = importlib.util.spec_from_file_location("parsec_tpu._ptdtd",
-                                                          so)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            _ptdtd_mod[0] = mod
-            output.debug_verbose(1, "native", f"_ptdtd loaded from {so}")
-        except Exception as e:  # noqa: BLE001
-            output.debug_verbose(1, "native", f"_ptdtd load failed: {e}")
-        return _ptdtd_mod[0]
+    return _load_pyext("_ptdtd", _ptdtd_mod)
+
+
+def load_ptexec():
+    """The CPython-extension PTG execution lane (native/src/ptexec.cpp),
+    or None. Runs the generic task FSM — dep-count decrement, ready
+    detect, dispatch, successor release — over a flattened successor
+    table, batched, with the GIL dropped across the walk (see
+    docs/native_exec.md for the eligibility and GIL contract)."""
+    return _load_pyext("_ptexec", _ptexec_mod)
 
 
 class NativeDepTable:

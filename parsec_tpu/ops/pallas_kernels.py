@@ -308,6 +308,19 @@ def stencil1d(x, left, right, weights=(0.25, 0.5, 0.25)):
         return (w0 * xm + w1 * x + w2 * xp).astype(x.dtype)
 
 
+def _sds(jax, shape, dtype, vma=None):
+    """``ShapeDtypeStruct`` with a version-tolerant ``vma``: newer jax
+    types shard_map-varying outputs through the kwarg; older jax has no
+    VMA checker at all, so dropping it there is the correct degrade
+    (passing even ``vma=None`` raises TypeError on those versions)."""
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=set(vma))
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
@@ -393,8 +406,7 @@ def _flash_attn_call(bh: int, sq: int, sk: int, d: int, bq: int, bk: int,
             pl.BlockSpec((1, bk, d), lambda b, iq, kk: (b, kk, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, iq, kk: (b, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), dtype,
-                                       vma=set(vma) if vma else None),
+        out_shape=_sds(jax, (bh, sq, d), dtype, vma),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),     # acc
             pltpu.VMEM((bq, 128), jnp.float32),   # running max (lanes equal)

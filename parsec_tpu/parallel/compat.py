@@ -1,0 +1,40 @@
+"""jax API compatibility shims for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the ``jax``
+top level across jax releases, and its replication-checker kwarg was
+renamed ``check_rep`` -> ``check_vma``. The two changes did NOT land in
+the same release, so the kwarg spelling is probed by TypeError rather
+than inferred from where the symbol lives. Import ``shard_map`` from
+here so every SPMD module works on any of the three vintages.
+"""
+
+try:                                     # newer jax: top-level
+    from jax import shard_map as _sm     # type: ignore[attr-defined]
+    _EXPERIMENTAL = False
+except ImportError:                      # jax 0.4/0.5: experimental
+    from jax.experimental.shard_map import shard_map as _sm
+    _EXPERIMENTAL = True
+
+
+def shard_map(f, **kwargs):
+    """Version-tolerant ``shard_map``. Callers use the current kwarg
+    spelling (``check_vma``); the shim translates for older signatures.
+
+    On the experimental vintage the checker additionally defaults OFF:
+    its shard_map transpose under ``check_rep=True`` produces symbolic
+    ``Zero`` tangents that crash ``psum`` gradients (the
+    upstream-documented workaround; newer jax needs neither)."""
+    if _EXPERIMENTAL:
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        kwargs.setdefault("check_rep", False)
+        return _sm(f, **kwargs)
+    try:
+        return _sm(f, **kwargs)
+    except TypeError:
+        # transition-window jax: top-level symbol, pre-rename signature
+        if "check_vma" in kwargs:
+            kw = dict(kwargs)
+            kw["check_rep"] = kw.pop("check_vma")
+            return _sm(f, **kw)
+        raise

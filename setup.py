@@ -42,6 +42,8 @@ setup(
     ext_modules=[
         Extension("parsec_tpu._ptdtd", ["native/src/ptdtd.cpp"],
                   extra_compile_args=["-O3", "-std=c++17"]),
+        Extension("parsec_tpu._ptexec", ["native/src/ptexec.cpp"],
+                  extra_compile_args=["-O3", "-std=c++17"]),
         Extension("parsec_tpu._ptcore", ["native/src/ptcore.cpp"],
                   extra_compile_args=["-O3", "-std=c++17"]),
     ],

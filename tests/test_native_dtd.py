@@ -27,17 +27,25 @@ def _engine():
 
 # ---------------------------------------------------------------- C engine
 
+def _ins(e, tiles, accs):
+    """insert + activate (the count-then-activate protocol): returns
+    (task_id, deps_remaining_after_guard_drop)."""
+    tid, held = e.insert(tiles, accs)
+    assert held >= 1                         # insertion guard still held
+    return tid, e.activate(tid)
+
+
 def test_engine_raw_chain_semantics():
     """w0 -> {r1, r2} -> w3: RAW, WAR, and retire-once, straight on the
     C extension."""
     e = _engine()
     t = e.tile()
-    tid, nd = e.insert((t,), (WRITE,))
+    tid, nd = _ins(e, (t,), (WRITE,))
     assert nd == 0
-    r1, nd1 = e.insert((t,), (READ,))
-    r2, nd2 = e.insert((t,), (READ,))
+    r1, nd1 = _ins(e, (t,), (READ,))
+    r2, nd2 = _ins(e, (t,), (READ,))
     assert nd1 == nd2 == 1                   # RAW on w0
-    w3, nd3 = e.insert((t,), (RW,))
+    w3, nd3 = _ins(e, (t,), (RW,))
     assert nd3 == 3                          # WAR on r1,r2 + WAW on w0
     assert e.complete(tid) == (r1, r2)
     assert e.complete(r1) == ()
@@ -46,14 +54,30 @@ def test_engine_raw_chain_semantics():
     assert e.pending() == 0
 
 
+def test_engine_guard_held_until_activate():
+    """Between insert() and activate(), a completing predecessor must NOT
+    surface the new task as ready (the activation race, ADVICE.md r5):
+    the guard keeps its count above zero until the inserter publishes it."""
+    e = _engine()
+    t = e.tile()
+    w, ndw = _ins(e, (t,), (WRITE,))
+    assert ndw == 0
+    r, held = e.insert((t,), (READ,))        # RAW on w; guard held
+    assert held == 2                         # guard + RAW
+    assert e.complete(w) == ()               # NOT released: guard holds it
+    assert e.activate(r) == 0                # inserter drops guard: ready
+    assert e.complete(r) == ()
+    assert e.pending() == 0
+
+
 def test_engine_write_resets_readers():
     e = _engine()
     t = e.tile()
-    w0, _ = e.insert((t,), (WRITE,))
-    r, _ = e.insert((t,), (READ,))
-    w1, ndw = e.insert((t,), (WRITE,))       # WAR on r, WAW on w0
+    w0, _ = _ins(e, (t,), (WRITE,))
+    r, _ = _ins(e, (t,), (READ,))
+    w1, ndw = _ins(e, (t,), (WRITE,))        # WAR on r, WAW on w0
     assert ndw == 2
-    r2, ndr = e.insert((t,), (READ,))        # RAW on w1 ONLY (readers reset)
+    r2, ndr = _ins(e, (t,), (READ,))         # RAW on w1 ONLY (readers reset)
     assert ndr == 1
     e.complete(w0)
     e.complete(r)
@@ -65,8 +89,8 @@ def test_engine_dedup_multi_flow():
     (pred dedup via visit stamps)."""
     e = _engine()
     ta, tb = e.tile(), e.tile()
-    w, _ = e.insert((ta, tb), (WRITE, WRITE))
-    r, nd = e.insert((ta, tb), (READ, READ))
+    w, _ = _ins(e, (ta, tb), (WRITE, WRITE))
+    r, nd = _ins(e, (ta, tb), (READ, READ))
     assert nd == 1
     assert e.complete(w) == (r,)
 
@@ -74,7 +98,7 @@ def test_engine_dedup_multi_flow():
 def test_engine_completed_twice_raises():
     e = _engine()
     t = e.tile()
-    tid, _ = e.insert((t,), (WRITE,))
+    tid, _ = _ins(e, (t,), (WRITE,))
     e.complete(tid)
     with pytest.raises(RuntimeError):
         e.complete(tid)
@@ -85,13 +109,13 @@ def test_engine_reader_compaction():
     WAR count of the next write."""
     e = _engine()
     t = e.tile()
-    w0, _ = e.insert((t,), (WRITE,))
+    w0, _ = _ins(e, (t,), (WRITE,))
     e.complete(w0)
     for _ in range(300):
-        rid, nd = e.insert((t,), (READ,))
+        rid, nd = _ins(e, (t,), (READ,))
         assert nd == 0                       # writer completed
         e.complete(rid)
-    w1, nd = e.insert((t,), (WRITE,))
+    w1, nd = _ins(e, (t,), (WRITE,))
     assert nd == 0                           # every reader already retired
     tasks_ever, tiles_ever = e.sizes()
     assert tasks_ever == 302 and tiles_ever == 1
@@ -236,6 +260,38 @@ def test_native_lane_concurrent_inserters(ctx):
         for t in tls:
             total += float(np.asarray(t.data.newest_copy().payload)[0, 0])
     assert total == nthreads * per_thread, total
+
+
+def test_native_lane_activation_race_with_live_workers():
+    """Regression (ADVICE.md r5 high, dtd.py:590): with worker threads
+    LIVE during insertion, a fast predecessor completing in the gap
+    between Engine.insert() and the id->task map store must not surface
+    the unpublished id (KeyError in _schedule_native_ready). The
+    count-then-activate protocol holds the insertion guard inside the
+    engine until activate(tid) runs after the map is populated."""
+    import threading
+
+    c = pt.Context(nb_cores=2)
+    try:
+        tp = DTDTaskpool(c, "race")
+        assert tp._native_engine() is not None, "native lane should engage"
+        c.start()            # workers live BEFORE the insert storm
+        tiles = [tp.tile_new((2, 2), np.float32) for _ in range(4)]
+        for t in tiles:
+            t.data.create_copy(0, np.zeros((2, 2), np.float32))
+        n = 20000
+        for i in range(n):
+            # WAW chains per tile: every insert's predecessor is a task
+            # the workers are racing to complete right now
+            tp.insert_task(lambda a: a + 1.0, (tiles[i % 4], RW), jit=False)
+        tp.wait(timeout=120)
+        tp.close()
+        c.wait(timeout=60)
+        total = sum(float(np.asarray(t.data.newest_copy().payload)[0, 0])
+                    for t in tiles)
+        assert total == n, total
+    finally:
+        c.fini()
 
 
 def test_native_lane_window_pressure(ctx):

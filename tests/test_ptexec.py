@@ -1,0 +1,335 @@
+"""Native PTG execution lane (native/src/ptexec.cpp + the compiler's
+flatten/classify wiring, docs/native_exec.md).
+
+Three layers:
+
+* raw Graph semantics on the C extension (release edges, replay reset,
+  budget bursts, callback-error poisoning);
+* randomized-DAG parity: the SAME PTG program runs with the lane on and
+  off, and both executions must produce the identical completion set with
+  every release edge respected in the observed body order (the
+  "bit-identical release semantics" contract of the lane);
+* runtime integration: eligibility fallbacks, multi-worker chain drain.
+"""
+
+import math
+import random
+import threading
+
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu import native as native_mod
+from parsec_tpu.dsl.ptg.compiler import compile_ptg
+from parsec_tpu.utils import mca
+
+pytestmark = pytest.mark.skipif(native_mod.load_ptexec() is None,
+                                reason="native _ptexec unavailable")
+
+
+def _graph(*args):
+    return native_mod.load_ptexec().Graph(*args)
+
+
+# ------------------------------------------------------------------ raw graph
+
+def test_graph_diamond_order_and_replay():
+    # 0 -> {1, 2} -> 3
+    g = _graph([0, 1, 1, 2], [0, 2, 3, 4, 4], [1, 2, 3, 3])
+    for _ in range(3):                     # replay via reset()
+        order = []
+        assert g.run(order.extend, 256, 0) == 4
+        assert g.done() and g.pending() == 0
+        pos = {t: i for i, t in enumerate(order)}
+        assert pos[0] < pos[1] and pos[0] < pos[2]
+        assert pos[1] < pos[3] and pos[2] < pos[3]
+        g.reset()
+
+
+def test_graph_budget_bursts():
+    """budget>0 returns mid-graph; repeated calls finish the walk — the
+    burst handoff the hot loop relies on to interleave other work."""
+    n = 100
+    goals = [0] + [1] * (n - 1)            # one long chain
+    off = list(range(n)) + [n - 1]
+    succs = list(range(1, n))
+    g = _graph(goals, off, succs)
+    total = 0
+    calls = 0
+    while not g.done():
+        total += g.run(None, 8, 10)
+        calls += 1
+        assert calls < 1000
+    assert total == n and calls > 1
+
+
+def test_graph_callback_error_poisons():
+    g = _graph([0, 1], [0, 1, 1], [1])
+
+    def boom(ids):
+        raise ValueError("body failed")
+
+    with pytest.raises(ValueError):
+        g.run(boom, 256, 0)
+    assert g.failed() and not g.done()
+    g.reset()                              # reset clears the poison
+    assert g.run(None, 256, 0) == 2 and g.done()
+
+
+def test_graph_structural_validation():
+    with pytest.raises(ValueError):
+        _graph([0, 0], [0, 1], [1])        # succ_off must have n+1 entries
+    with pytest.raises(ValueError):
+        _graph([0, 0], [0, 1, 1], [7])     # successor id out of range
+    with pytest.raises(ValueError):
+        _graph([0, -1], [0, 0, 0], [])     # negative goal
+
+
+# -------------------------------------------------------- randomized parity
+
+_RND_SRC = """%global N
+%global D
+%global A
+%global B
+%global C
+%global E
+%global M
+%global IA
+%global IC
+%global rec
+SRC(i)
+  i = 0 .. N-1
+  CTL S -> X T(((A*i+B) % N), 0)
+BODY
+  rec(('SRC', i))
+END
+
+T(i, l)
+  i = 0 .. N-1
+  l = 0 .. D-1
+  CTL X <- (l == 0) ? S SRC(((IA*(i-B)) % N)) : X T(i, l-1)
+        -> (l < D-1) ? X T(i, l+1)
+  CTL Y <- (l > 0 and ((IC*(i-E)) % N) % M == 0) ? Y T(((IC*(i-E)) % N), l-1)
+        -> (l < D-1 and i % M == 0) ? Y T(((C*i+E) % N), l+1)
+BODY
+  rec(('T', i, l))
+END
+"""
+
+
+def _rand_shape(seed):
+    rng = random.Random(seed)
+    N = rng.choice([8, 12, 16, 20])
+    D = rng.randrange(3, 7)
+    coprimes = [c for c in range(1, N) if math.gcd(c, N) == 1]
+    A, C = rng.choice(coprimes), rng.choice(coprimes)
+    B, E = rng.randrange(N), rng.randrange(N)
+    M = rng.randrange(2, 5)
+    return dict(N=N, D=D, A=A, B=B, C=C, E=E, M=M,
+                IA=pow(A, -1, N), IC=pow(C, -1, N))
+
+
+def _expected_edges(p):
+    N, D, A, B, C, E, M = (p[k] for k in "NDABCEM")
+    edges = [(("SRC", i), ("T", (A * i + B) % N, 0)) for i in range(N)]
+    for i in range(N):
+        for l in range(D - 1):
+            edges.append((("T", i, l), ("T", i, l + 1)))
+            if i % M == 0:
+                edges.append((("T", i, l), ("T", (C * i + E) % N, l + 1)))
+    return edges
+
+
+def _run_dag(params, native: bool, nb_cores: int = 1):
+    order = []
+    ctx = pt.Context(nb_cores=nb_cores)
+    try:
+        if not native:
+            mca.set("ptg_native_exec", False)
+        prog = compile_ptg(_RND_SRC, "rnd")
+        tp = prog.instantiate(ctx, globals=dict(params, rec=order.append),
+                              collections={})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+        if native:
+            assert tp._ptexec_state is not None, "lane should have engaged"
+            assert tp._ptexec_state["graph"].done()
+        else:
+            assert tp._ptexec_state is None, "lane should have been off"
+    finally:
+        if not native:
+            mca.params.unset("ptg_native_exec")
+        ctx.fini()
+    return order
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+def test_randomized_dag_parity(seed):
+    """Native lane vs Python FSM on the same randomized DAG: identical
+    completion sets, no duplicates, and every release edge respected in
+    the observed body execution order — in BOTH modes."""
+    params = _rand_shape(seed)
+    expected = {("SRC", i) for i in range(params["N"])} | \
+        {("T", i, l) for i in range(params["N"]) for l in range(params["D"])}
+    edges = _expected_edges(params)
+    orders = {m: _run_dag(params, native=m) for m in (True, False)}
+    for mode, order in orders.items():
+        assert len(order) == len(expected), f"mode={mode}: dup/lost tasks"
+        assert set(order) == expected, f"mode={mode}: wrong completion set"
+        pos = {t: i for i, t in enumerate(order)}
+        for pred, succ in edges:
+            assert pos[pred] < pos[succ], \
+                f"mode={mode}: release edge {pred}->{succ} violated"
+
+
+def test_flatten_cache_replay_parity():
+    """Same program object, same globals, three instantiations: the cached
+    flattened graph replays (reset) with full parity every time."""
+    params = _rand_shape(99)
+    expected_n = params["N"] * (1 + params["D"])
+    prog = compile_ptg(_RND_SRC, "rnd-cache")
+    ctx = pt.Context(nb_cores=1)
+    try:
+        for rep in range(3):
+            order = []
+            tp = prog.instantiate(ctx, globals=dict(params,
+                                                    rec=order.append),
+                                  collections={})
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=120)
+            assert tp._ptexec_state is not None
+            assert len(order) == expected_n and len(set(order)) == expected_n
+    finally:
+        ctx.fini()
+
+
+# --------------------------------------------------------------- integration
+
+def test_lane_multiworker_chain_smoke():
+    """nb_cores=4 drains one empty-body chain DAG through the lane with
+    every stream eligible to join the GIL-free walk; the graph completes
+    and the per-stream execution counts add up."""
+    src = ("%global NT\n%global DEPTH\n"
+           "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. DEPTH-1\n"
+           "  CTL S <- (l > 0) ? S T(i, l-1)\n"
+           "        -> (l < DEPTH-1) ? S T(i, l+1)\nBODY\n  pass\nEND\n")
+    nt, depth = 512, 32
+    ctx = pt.Context(nb_cores=4)
+    try:
+        prog = compile_ptg(src, "mt-chain")
+        tp = prog.instantiate(ctx, globals={"NT": nt, "DEPTH": depth},
+                              collections={})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        assert tp._ptexec_state is not None
+        assert tp._ptexec_state["graph"].done()
+        assert sum(s.nb_executed for s in ctx.streams) == nt * depth
+    finally:
+        ctx.fini()
+
+
+def test_lane_body_error_surfaces():
+    src = ("%global NT\n%global boom\n"
+           "T(i)\n  i = 0 .. NT-1\n"
+           "  CTL S -> (i < NT-1) ? S T(i+1)\nBODY\n  boom(i)\nEND\n")
+
+    def boom(i):
+        if i == 3:
+            raise ValueError("intentional body failure")
+
+    ctx = pt.Context(nb_cores=1)
+    try:
+        prog = compile_ptg(src, "err")
+        tp = prog.instantiate(ctx, globals={"NT": 8, "boom": boom},
+                              collections={})
+        with pytest.raises(ValueError):
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=30)
+    finally:
+        ctx.fini()
+
+
+def test_lane_body_error_surfaces_with_workers():
+    """Multi-worker error path: whichever stream's callback raises, the
+    error must poison the graph, retire every other worker from it, and
+    surface at the master's wait() — never hang (the non-master branch of
+    _ptexec_drain and the graph.failed() peer-retire branch)."""
+    src = ("%global NT\n%global boom\n"
+           "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. 3\n"
+           "  CTL S <- (l > 0) ? S T(i, l-1)\n"
+           "        -> (l < 3) ? S T(i, l+1)\nBODY\n  boom(i, l)\nEND\n")
+
+    def boom(i, l):
+        if i == 37 and l == 2:
+            raise ValueError("intentional multiworker body failure")
+
+    ctx = pt.Context(nb_cores=4)
+    try:
+        prog = compile_ptg(src, "mt-err")
+        tp = prog.instantiate(ctx, globals={"NT": 256, "boom": boom},
+                              collections={})
+        with pytest.raises(ValueError, match="multiworker body failure"):
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=30)
+        assert tp._ptexec_state["graph"].failed()
+    finally:
+        ctx.fini()
+
+
+def test_lane_fallback_data_flows():
+    """Data-carrying classes stay on the Python FSM (repos, reshapes, and
+    copy semantics live there)."""
+    import numpy as np
+    from parsec_tpu.data.matrix import TiledMatrix
+
+    src = ("%global NT\n%global descA\n"
+           "T(k)\n  k = 0 .. NT-1\n"
+           "  RW X <- (k == 0) ? descA(0, k) : X T(k-1)\n"
+           "       -> (k < NT-1) ? X T(k+1) : descA(0, k)\n"
+           "BODY\n  X = X + 1.0\nEND\n")
+    ctx = pt.Context(nb_cores=1)
+    try:
+        A = TiledMatrix("laneA", 1, 4, 1, 1)
+        A.fill(lambda m, k: np.zeros((1, 1), np.float32))
+        prog = compile_ptg(src, "data")
+        tp = prog.instantiate(ctx, globals={"NT": 4},
+                              collections={"descA": A})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        assert tp._ptexec_state is None, "data flows must not take the lane"
+    finally:
+        ctx.fini()
+
+
+def test_lane_fallback_priority_class():
+    """A priority policy means release ORDER is policy-visible — the lane
+    (edge-respecting but priority-blind) must decline."""
+    src = ("%global NT\n"
+           "T(i)\n  i = 0 .. NT-1\n  priority = NT - i\n"
+           "  CTL S -> (i < NT-1) ? S T(i+1)\nBODY\n  pass\nEND\n")
+    ctx = pt.Context(nb_cores=1)
+    try:
+        prog = compile_ptg(src, "prio")
+        tp = prog.instantiate(ctx, globals={"NT": 4}, collections={})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+        assert tp._ptexec_state is None
+    finally:
+        ctx.fini()
+
+
+def test_lane_off_by_mca():
+    src = ("%global NT\n"
+           "T(i)\n  i = 0 .. NT-1\n"
+           "  CTL S -> (i < NT-1) ? S T(i+1)\nBODY\n  pass\nEND\n")
+    mca.set("ptg_native_exec", False)
+    ctx = pt.Context(nb_cores=1)
+    try:
+        prog = compile_ptg(src, "off")
+        tp = prog.instantiate(ctx, globals={"NT": 4}, collections={})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+        assert tp._ptexec_state is None
+    finally:
+        mca.params.unset("ptg_native_exec")
+        ctx.fini()

@@ -20,6 +20,70 @@ N, TS = 32, 16
 _SEED = 11
 
 
+# -------------------------------------------------- failure attribution unit
+
+def test_transport_error_classification():
+    """Typed checks first; PJRT-plane markers attribute outright; weak
+    markers (words ordinary local errors also use) are at most ambiguous
+    (ADVICE.md r5: substring matching let a local RuntimeError containing
+    'RESET' mark a live peer dead)."""
+    from parsec_tpu.comm.tcp import classify_transport_error as cls
+
+    assert cls(ConnectionResetError("peer went away")) == "transport"
+    assert cls(TimeoutError("recv timed out")) == "transport"
+    assert cls(EOFError()) == "transport"
+    assert cls(RuntimeError(
+        "UNAVAILABLE: failed to connect to all addresses")) == "transport"
+    assert cls(RuntimeError("transfer server handshake lost")) == "transport"
+    # weak markers in a backend RuntimeError: ambiguous, never outright
+    assert cls(RuntimeError("buffer RESET while tracing")) == "ambiguous"
+    assert cls(RuntimeError("stream CLOSED mid-collective")) == "ambiguous"
+    # non-RuntimeError non-socket exceptions are this rank's own fault
+    assert cls(ValueError("connection reset by peer")) == "local"
+    # the consumer's own OOM is never the wire
+    assert cls(RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                            "while UNAVAILABLE")) == "local"
+    assert cls(RuntimeError("shape mismatch in reduction")) == "local"
+
+
+def test_attributed_pull_retry_semantics():
+    """Ambiguous failures retry once: transient hiccups recover, spoofed
+    local messages raise locally, and only genuine transport verdicts
+    mark the peer."""
+    from parsec_tpu.comm.tcp import _attributed_pull
+
+    calls = []
+
+    def flaky(ref):
+        calls.append(ref)
+        if len(calls) == 1:
+            raise RuntimeError("stream CLOSED unexpectedly")
+        return "payload"
+
+    assert _attributed_pull(flaky, 1) == ("ok", "payload")
+    assert len(calls) == 2
+
+    # deterministic LOCAL error with a spoofed weak marker: raises; a live
+    # peer is never blamed for it
+    def spoofed(ref):
+        raise RuntimeError("tensor RESET in local op")
+
+    with pytest.raises(RuntimeError, match="tensor RESET"):
+        _attributed_pull(spoofed, 1)
+
+    def gone(ref):
+        raise RuntimeError("UNAVAILABLE: transfer server unreachable")
+
+    status, exc = _attributed_pull(gone, 1)
+    assert status == "transport"
+
+    def oom(ref):
+        raise RuntimeError("RESOURCE_EXHAUSTED: device OOM")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        _attributed_pull(oom, 1)
+
+
 def _force_cpu():
     import jax
     try:
