@@ -20,6 +20,56 @@ assert native.load_ptexec() is not None, "_ptexec built but failed to load"
 print("native artifacts OK (ptcore, ptdtd, ptexec)")
 EOF
 
+echo "== no compiled artifacts tracked/staged =="
+# .gitignore already covers __pycache__/*.pyc; this guards the regression
+# where one gets force-added (or a stale one resurrected) anyway
+if git ls-files | grep -E '(^|/)__pycache__/|\.pyc$'; then
+    echo "ERROR: .pyc/__pycache__ artifacts are tracked or staged" >&2
+    exit 1
+fi
+
+echo "== native lane engagement smoke =="
+# perf gate by ENGAGEMENT, not throughput: a noisy host can't flake it,
+# but a silent fall-back to the Python FSM on an eligible pool (the 48x
+# regression) fails it deterministically
+JAX_PLATFORMS=cpu timeout 120 python3 - <<'EOF'
+import numpy as np
+import parsec_tpu as pt
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg.compiler import compile_ptg, PTEXEC_STATS
+
+ctx = pt.Context(nb_cores=1)
+# dependent-chain micro-bench shape (CTL)
+chain = compile_ptg(
+    "%global NT\n%global DEPTH\n"
+    "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. DEPTH-1\n"
+    "  CTL S <- (l > 0) ? S T(i, l-1)\n"
+    "        -> (l < DEPTH-1) ? S T(i, l+1)\nBODY\n  pass\nEND\n", "ci-chain")
+tp = chain.instantiate(ctx, globals={"NT": 64, "DEPTH": 16}, collections={})
+ctx.add_taskpool(tp); ctx.wait(timeout=60)
+assert tp._ptexec_state is not None, "CTL chain pool fell back to Python FSM"
+# data-flow micro-bench shape (RW chains + memory endpoints)
+X = TiledMatrix("descX", 1, 32, 1, 1)
+X.fill(lambda m, i: np.zeros((1, 1), np.float32))
+Y = TiledMatrix("descY", 1, 32, 1, 1)
+df = compile_ptg(
+    "%global NT\n%global DEPTH\n%global descX\n%global descY\n"
+    "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. DEPTH-1\n"
+    "  RW X <- (l == 0) ? descX(0, i) : X T(i, l-1)\n"
+    "       -> (l < DEPTH-1) ? X T(i, l+1) : descY(0, i)\n"
+    "BODY\n  pass\nEND\n", "ci-df")
+tp2 = df.instantiate(ctx, globals={"NT": 32, "DEPTH": 8},
+                     collections={"descX": X, "descY": Y})
+ctx.add_taskpool(tp2); ctx.wait(timeout=60)
+assert tp2._ptexec_state is not None, \
+    "data-flow chain pool fell back to Python FSM"
+assert tp2._ptexec_state["graph"].done()
+assert PTEXEC_STATS["pools_engaged"] >= 2 and \
+    PTEXEC_STATS["pools_fallback"] == 0, PTEXEC_STATS
+ctx.fini()
+print(f"native lane engagement OK: {PTEXEC_STATS}")
+EOF
+
 echo "== byte-compile lint (syntax over the whole tree) =="
 python3 -m compileall -q parsec_tpu tests examples benchmarks bench.py \
     __graft_entry__.py setup.py

@@ -12,15 +12,31 @@
 // PTG taskpool's dependency structure into the CSR successor table this
 // engine consumes, once per (program, globals) shape.
 //
+// DATA-FLOW MODE (the second lowering): a graph may additionally carry
+//   * per-task priorities — the ready structure becomes a max-heap, so a
+//     pop always dispatches a maximal-priority ready task;
+//   * an input-slot CSR + per-slot usage limits — the datarepo retire
+//     protocol (core/datarepo.py usagelmt/usagecnt) moves HERE: every
+//     data flow of every task owns one slot id; consuming tasks list
+//     their input slots; the release sweep decrements the slot's atomic
+//     remaining-use counter and reports fully-consumed slot ids back to
+//     Python, which clears the payload reference. The payloads themselves
+//     never cross into C — Python owns the slot *values* (a flat list),
+//     C owns the slot *lifetimes*.
+// In data mode the batch callback takes TWO arguments,
+// (ready_ids, retired_slot_ids); without slots it keeps the historic
+// one-argument form.
+//
 // Concurrency contract: run() may be called from MANY Python threads on
 // the same Graph. The GIL is dropped for the whole FSM walk (ready-pop,
 // decrement, release) and re-acquired only to dispatch a batch of
 // non-empty task bodies through the Python callback — so for empty/CTL
 // task classes the walk is GIL-free end to end and Context(nb_cores>1)
 // in-process workers scale on real cores. Shared state is a small mutex
-// around the ready stack plus per-task atomic dependency counters; the
-// release decrement uses fetch_sub so two workers releasing into the same
-// successor can never double-ready it.
+// around the ready structure plus per-task (and per-slot) atomic
+// counters; the release decrement uses fetch_sub so two workers
+// releasing into the same successor (or retiring the same slot) can
+// never double-fire it.
 //
 // run() never blocks waiting for work: a starved worker returns to the
 // Python hot loop (which has its own backoff and other task sources) and
@@ -47,10 +63,21 @@ struct Graph {
     std::vector<int32_t> *seeds;     // ids with goal 0
     std::atomic<int32_t> *counts;    // remaining deps per task
     std::mutex *mu;                  // guards ready/completed/running/error
-    std::vector<int32_t> *ready;     // LIFO work stack
+    std::vector<int32_t> *ready;     // LIFO stack, or max-heap when prio set
     int64_t completed;
     int32_t running;                 // workers mid-batch
     bool error;                      // a callback raised somewhere
+    // priority mode (empty prio, use_heap=false -> plain LIFO stack)
+    std::vector<int32_t> *prio;      // per-task priority
+    bool use_heap;
+    // data-flow mode (empty in_off -> pure control graph)
+    std::vector<int32_t> *in_off;    // CSR n+1: consumed slots per task
+    std::vector<int32_t> *in_slots;  // flattened input slot ids
+    std::vector<int32_t> *slot_uses; // usage limit per slot (the usagelmt)
+    std::atomic<int32_t> *slot_cnt;  // remaining uses (usagelmt - usagecnt)
+    std::vector<int32_t> *retired;   // fully-consumed slots awaiting Python
+    int64_t n_slots;
+    int64_t nb_slots_retired;        // total retired (guarded by mu)
 };
 
 bool parse_i32_list(PyObject *obj, std::vector<int32_t> &out,
@@ -69,11 +96,29 @@ bool parse_i32_list(PyObject *obj, std::vector<int32_t> &out,
     return true;
 }
 
+// max-heap ordering on (priority, id): a pop yields a maximal-priority
+// ready task; among equal priorities the higher id wins (deterministic,
+// roughly LIFO for sequentially-released work).
+struct PrioLess {
+    const int32_t *p;
+    bool operator()(int32_t a, int32_t b) const {
+        return p[a] < p[b] || (p[a] == p[b] && a < b);
+    }
+};
+
 void graph_reset_state(Graph *self) {
     for (int64_t i = 0; i < self->n; i++)
         self->counts[i].store((*self->goals)[(size_t)i],
                               std::memory_order_relaxed);
     *self->ready = *self->seeds;
+    if (self->use_heap)
+        std::make_heap(self->ready->begin(), self->ready->end(),
+                       PrioLess{self->prio->data()});
+    for (int64_t j = 0; j < self->n_slots; j++)
+        self->slot_cnt[j].store((*self->slot_uses)[(size_t)j],
+                                std::memory_order_relaxed);
+    self->retired->clear();
+    self->nb_slots_retired = 0;
     self->completed = 0;
     self->running = 0;
     self->error = false;
@@ -81,7 +126,10 @@ void graph_reset_state(Graph *self) {
 
 PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     PyObject *goals_o, *off_o, *succs_o;
-    if (!PyArg_ParseTuple(args, "OOO", &goals_o, &off_o, &succs_o))
+    PyObject *prio_o = Py_None, *in_off_o = Py_None, *in_slots_o = Py_None,
+             *uses_o = Py_None;
+    if (!PyArg_ParseTuple(args, "OOO|OOOO", &goals_o, &off_o, &succs_o,
+                          &prio_o, &in_off_o, &in_slots_o, &uses_o))
         return nullptr;
     Graph *self = reinterpret_cast<Graph *>(type->tp_alloc(type, 0));
     if (!self) return nullptr;
@@ -91,9 +139,18 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     self->seeds = new (std::nothrow) std::vector<int32_t>();
     self->ready = new (std::nothrow) std::vector<int32_t>();
     self->mu = new (std::nothrow) std::mutex();
+    self->prio = new (std::nothrow) std::vector<int32_t>();
+    self->in_off = new (std::nothrow) std::vector<int32_t>();
+    self->in_slots = new (std::nothrow) std::vector<int32_t>();
+    self->slot_uses = new (std::nothrow) std::vector<int32_t>();
+    self->retired = new (std::nothrow) std::vector<int32_t>();
     self->counts = nullptr;
+    self->slot_cnt = nullptr;
+    self->use_heap = false;
+    self->n_slots = 0;
     if (!self->goals || !self->succ_off || !self->succs || !self->seeds ||
-        !self->ready || !self->mu) {
+        !self->ready || !self->mu || !self->prio || !self->in_off ||
+        !self->in_slots || !self->slot_uses || !self->retired) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -103,6 +160,28 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
         !parse_i32_list(succs_o, *self->succs, "succs: sequence of ints")) {
         Py_DECREF(self);
         return nullptr;
+    }
+    if (prio_o != Py_None &&
+        !parse_i32_list(prio_o, *self->prio, "prio: sequence of ints")) {
+        Py_DECREF(self);
+        return nullptr;
+    }
+    if (in_off_o != Py_None) {
+        if (in_slots_o == Py_None || uses_o == Py_None) {
+            PyErr_SetString(PyExc_TypeError,
+                            "in_off requires in_slots and slot_uses");
+            Py_DECREF(self);
+            return nullptr;
+        }
+        if (!parse_i32_list(in_off_o, *self->in_off,
+                            "in_off: sequence of ints") ||
+            !parse_i32_list(in_slots_o, *self->in_slots,
+                            "in_slots: sequence of ints") ||
+            !parse_i32_list(uses_o, *self->slot_uses,
+                            "slot_uses: sequence of ints")) {
+            Py_DECREF(self);
+            return nullptr;
+        }
     }
     self->n = (int64_t)self->goals->size();
     // structural validation once at build: run() then needs no bounds checks
@@ -133,6 +212,54 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
             return nullptr;
         }
     }
+    if (!self->prio->empty()) {
+        if ((int64_t)self->prio->size() != self->n) {
+            PyErr_SetString(PyExc_ValueError, "prio must have n entries");
+            Py_DECREF(self);
+            return nullptr;
+        }
+        for (int32_t p : *self->prio)
+            if (p != 0) { self->use_heap = true; break; }
+        if (!self->use_heap) self->prio->clear();   // all-zero: plain stack
+    }
+    if (!self->in_off->empty()) {
+        self->n_slots = (int64_t)self->slot_uses->size();
+        if ((int64_t)self->in_off->size() != self->n + 1) {
+            PyErr_SetString(PyExc_ValueError, "in_off must have n+1 entries");
+            Py_DECREF(self);
+            return nullptr;
+        }
+        prev = 0;
+        for (int32_t o : *self->in_off) {
+            if (o < prev || (size_t)o > self->in_slots->size()) {
+                PyErr_SetString(PyExc_ValueError,
+                                "in_off not monotone in-range");
+                Py_DECREF(self);
+                return nullptr;
+            }
+            prev = o;
+        }
+        if ((size_t)self->in_off->back() != self->in_slots->size()) {
+            PyErr_SetString(PyExc_ValueError,
+                            "in_off must end at len(in_slots)");
+            Py_DECREF(self);
+            return nullptr;
+        }
+        for (int32_t j : *self->in_slots) {
+            if (j < 0 || (int64_t)j >= self->n_slots) {
+                PyErr_SetString(PyExc_ValueError, "input slot id out of range");
+                Py_DECREF(self);
+                return nullptr;
+            }
+        }
+        for (int32_t u : *self->slot_uses) {
+            if (u < 0) {
+                PyErr_SetString(PyExc_ValueError, "negative slot usage limit");
+                Py_DECREF(self);
+                return nullptr;
+            }
+        }
+    }
     for (int64_t i = 0; i < self->n; i++) {
         int32_t g = (*self->goals)[(size_t)i];
         if (g < 0) {
@@ -144,6 +271,13 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     }
     self->counts = new (std::nothrow) std::atomic<int32_t>[(size_t)self->n];
     if (self->n && !self->counts) {
+        Py_DECREF(self);
+        PyErr_NoMemory();
+        return nullptr;
+    }
+    self->slot_cnt = new (std::nothrow)
+        std::atomic<int32_t>[(size_t)self->n_slots];
+    if (self->n_slots && !self->slot_cnt) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -160,7 +294,13 @@ void graph_dealloc(PyObject *obj) {
     delete self->seeds;
     delete self->ready;
     delete self->mu;
+    delete self->prio;
+    delete self->in_off;
+    delete self->in_slots;
+    delete self->slot_uses;
+    delete self->retired;
     delete[] self->counts;
+    delete[] self->slot_cnt;
     Py_TYPE(obj)->tp_free(obj);
 }
 
@@ -184,16 +324,17 @@ PyObject *graph_reset(PyObject *obj, PyObject *) {
 // run(callback, batch, budget) -> number of tasks this caller executed.
 //
 //   callback: None for empty bodies (pure C walk), else a callable taking
-//             one list of ready task ids — it must run every body; the
-//             engine releases those tasks' successors only AFTER it
-//             returns (so an observer ordering recorded inside bodies
-//             always respects every release edge).
+//             one list of ready task ids — or, on a data-mode graph, TWO
+//             arguments (ready_ids, retired_slot_ids) — it must run every
+//             body; the engine releases those tasks' successors only
+//             AFTER it returns (so an observer ordering recorded inside
+//             bodies always respects every release edge).
 //   batch:    max ids per callback call / per release sweep.
 //   budget:   return after executing >= budget tasks even if the graph is
 //             not finished (0 = run until starved or done). The caller's
 //             hot loop interleaves other work and re-enters.
 //
-// Returns promptly (never blocks) when the ready stack is empty; check
+// Returns promptly (never blocks) when the ready structure is empty; check
 // done() to distinguish "finished" from "starved while peers run".
 PyObject *graph_run(PyObject *obj, PyObject *args) {
     Graph *self = reinterpret_cast<Graph *>(obj);
@@ -207,9 +348,20 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
         PyErr_SetString(PyExc_TypeError, "callback must be callable or None");
         return nullptr;
     }
+    const bool data_mode = !self->in_off->empty();
+    if (data_mode && callback == Py_None && self->n_slots > 0) {
+        // slot values live in Python; a data walk without the dispatcher
+        // would retire slots nobody ever clears or reads
+        PyErr_SetString(PyExc_TypeError,
+                        "data-mode graph requires a callback");
+        return nullptr;
+    }
     const int32_t *off = self->succ_off->data();
     const int32_t *succ = self->succs->data();
-    std::vector<int32_t> local, fresh;
+    const int32_t *ioff = data_mode ? self->in_off->data() : nullptr;
+    const int32_t *islot = data_mode ? self->in_slots->data() : nullptr;
+    const PrioLess cmp{self->use_heap ? self->prio->data() : nullptr};
+    std::vector<int32_t> local, fresh, freed;
     local.reserve((size_t)batch);
     int64_t mine = 0;
     PyThreadState *ts = PyEval_SaveThread();   // GIL dropped for the walk
@@ -221,9 +373,19 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                 stop = true;   // done, starved, or poisoned — caller decides
             } else {
                 size_t take = std::min((size_t)batch, self->ready->size());
-                local.assign(self->ready->end() - (ptrdiff_t)take,
-                             self->ready->end());
-                self->ready->resize(self->ready->size() - take);
+                if (self->use_heap) {
+                    // priority pops: the batch comes out highest-first
+                    for (size_t i = 0; i < take; i++) {
+                        std::pop_heap(self->ready->begin(),
+                                      self->ready->end(), cmp);
+                        local.push_back(self->ready->back());
+                        self->ready->pop_back();
+                    }
+                } else {
+                    local.assign(self->ready->end() - (ptrdiff_t)take,
+                                 self->ready->end());
+                    self->ready->resize(self->ready->size() - take);
+                }
                 self->running++;
             }
         }
@@ -232,17 +394,36 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             PyEval_RestoreThread(ts);
             ts = nullptr;
             PyObject *ids = PyList_New((Py_ssize_t)local.size());
+            PyObject *r = nullptr;
             if (ids) {
                 for (size_t i = 0; i < local.size(); i++)
                     PyList_SET_ITEM(ids, (Py_ssize_t)i,
                                     PyLong_FromLong(local[i]));
-                PyObject *r = PyObject_CallFunctionObjArgs(callback, ids,
-                                                           nullptr);
+                if (data_mode) {
+                    // hand over every slot retired since the last dispatch
+                    // (by ANY worker): the consumer bodies that used them
+                    // have all returned, so Python may drop the payloads
+                    std::vector<int32_t> ret;
+                    {
+                        std::lock_guard<std::mutex> lk(*self->mu);
+                        ret.swap(*self->retired);
+                    }
+                    PyObject *rl = PyList_New((Py_ssize_t)ret.size());
+                    if (rl) {
+                        for (size_t i = 0; i < ret.size(); i++)
+                            PyList_SET_ITEM(rl, (Py_ssize_t)i,
+                                            PyLong_FromLong(ret[i]));
+                        r = PyObject_CallFunctionObjArgs(callback, ids, rl,
+                                                         nullptr);
+                        Py_DECREF(rl);
+                    }
+                } else {
+                    r = PyObject_CallFunctionObjArgs(callback, ids, nullptr);
+                }
                 Py_DECREF(ids);
                 Py_XDECREF(r);
-                if (!r) ids = nullptr;   // reuse as the error marker
             }
-            if (!ids) {
+            if (!r) {
                 // a body raised: poison the graph so peers stop pulling
                 // work, undo our in-flight claim, propagate the exception
                 std::lock_guard<std::mutex> lk(*self->mu);
@@ -253,6 +434,7 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             ts = PyEval_SaveThread();
         }
         fresh.clear();
+        freed.clear();
         for (int32_t t : local) {
             for (int32_t k = off[t]; k < off[t + 1]; k++) {
                 int32_t s = succ[k];
@@ -260,14 +442,39 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                         1, std::memory_order_acq_rel) == 1)
                     fresh.push_back(s);
             }
+            if (data_mode) {
+                // the datarepo retire protocol: this task's bodies have
+                // run, so each input slot records one completed use; the
+                // LAST use retires the slot (usagecnt meets usagelmt)
+                for (int32_t k = ioff[t]; k < ioff[t + 1]; k++) {
+                    int32_t j = islot[k];
+                    if (self->slot_cnt[j].fetch_sub(
+                            1, std::memory_order_acq_rel) == 1)
+                        freed.push_back(j);
+                }
+            }
         }
         {
             std::lock_guard<std::mutex> lk(*self->mu);
             self->completed += (int64_t)local.size();
             self->running--;
-            if (!fresh.empty())
-                self->ready->insert(self->ready->end(), fresh.begin(),
-                                    fresh.end());
+            if (!fresh.empty()) {
+                if (self->use_heap) {
+                    for (int32_t s : fresh) {
+                        self->ready->push_back(s);
+                        std::push_heap(self->ready->begin(),
+                                       self->ready->end(), cmp);
+                    }
+                } else {
+                    self->ready->insert(self->ready->end(), fresh.begin(),
+                                        fresh.end());
+                }
+            }
+            if (!freed.empty()) {
+                self->retired->insert(self->retired->end(), freed.begin(),
+                                      freed.end());
+                self->nb_slots_retired += (int64_t)freed.size();
+            }
         }
         mine += (int64_t)local.size();
         local.clear();
@@ -293,6 +500,17 @@ PyObject *graph_failed(PyObject *obj, PyObject *) {
     Py_RETURN_FALSE;
 }
 
+PyObject *graph_idle(PyObject *obj, PyObject *) {
+    // True when no worker holds a claimed batch. After a poison (error
+    // set) no worker can claim a NEW batch, so idle==True is then stable
+    // — the safe moment for Python to drop the slot payloads of an
+    // abandoned data-mode graph (a mid-callback peer still reads them).
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (self->running == 0) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
 PyObject *graph_pending(PyObject *obj, PyObject *) {
     Graph *self = reinterpret_cast<Graph *>(obj);
     std::lock_guard<std::mutex> lk(*self->mu);
@@ -305,19 +523,30 @@ PyObject *graph_size(PyObject *obj, PyObject *) {
                          (Py_ssize_t)self->succs->size());
 }
 
+PyObject *graph_slot_stats(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    return Py_BuildValue("(LL)", (long long)self->n_slots,
+                         (long long)self->nb_slots_retired);
+}
+
 PyMethodDef graph_methods[] = {
     {"run", graph_run, METH_VARARGS,
      "run(callback=None, batch=256, budget=0) -> tasks executed by this call"},
     {"reset", graph_reset, METH_NOARGS,
-     "rewind dependency counters and the ready stack for a replay"},
+     "rewind dependency counters, slots, and the ready structure for replay"},
     {"done", graph_done, METH_NOARGS,
      "True when every task executed (and no error poisoned the run)"},
     {"failed", graph_failed, METH_NOARGS,
      "True when a body callback raised and poisoned the run"},
+    {"idle", graph_idle, METH_NOARGS,
+     "True when no worker holds a claimed batch (stable once poisoned)"},
     {"pending", graph_pending, METH_NOARGS,
      "tasks not yet executed"},
     {"size", graph_size, METH_NOARGS,
      "(n_tasks, n_edges)"},
+    {"slot_stats", graph_slot_stats, METH_NOARGS,
+     "(n_slots, n_slots_retired) — the lane-side datarepo retire counters"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyTypeObject GraphType = [] {

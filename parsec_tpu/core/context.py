@@ -170,6 +170,14 @@ class Context:
         # schedule() — the single hottest line of the EP profile)
         self._tls = threading.local()
         self._tls.stream = self.streams[0]
+        #: serializes progress loops on the MASTER stream: every
+        #: non-worker thread (wait()/wait_taskpool()/fini drain/DTD
+        #: window stall/direct _progress_loop users) drives streams[0],
+        #: and two concurrent drivers race on streams[0].next_task (the
+        #: read-then-clear hand-off can execute a task twice or drop it).
+        #: REENTRANT: nested loops on one thread (wait inside a drain)
+        #: are legal
+        self._master_loop_lock = threading.RLock()
         # schedule() only needs to wake anyone when parked workers or a
         # comm thread exist; single-core local runs skip the Event syscall
         # (RemoteDepEngine flips this when it attaches)
@@ -392,18 +400,27 @@ class Context:
         """One burst through the front lane graph. The burst budget shrinks
         when this stream's scheduler queues hold work so a live lane cannot
         starve concurrently-active taskpools; the graph's run() never
-        blocks, so a starved call returns straight to the hot loop."""
+        blocks, so a starved call returns straight to the hot loop.
+
+        For data-flow pools the callback IS the data path: each batched
+        dispatch reads its inputs from the lane's slot array, runs the
+        bodies, lands outputs back into slots, and clears the slot ids the
+        engine retired (the datarepo usagelmt/usagecnt protocol, kept in C)
+        — generic_prepare_input / generic_release_deps never run for lane
+        tasks. One callback per ~256 ready tasks amortizes the
+        lane-crossing cost the per-task FSM used to pay on every task."""
         with self._ptexec_lock:
             if not self._ptexec_q:
                 return False
             tp, lane = self._ptexec_q[0]
         graph = lane["graph"]
         # short bursts whenever (a) ordinary queues hold work, or (b) the
-        # lane dispatches eager Python bodies — a body-callback burst is
-        # bounded in TASK count, not time, so a long budget would blind
-        # this stream to newly scheduled tasks and peer errors for the
-        # whole burst. Empty-body walks run >10M tasks/s, so the long
-        # budget still returns within ~0.5s
+        # lane dispatches Python bodies (eager CTL callbacks or the
+        # data-flow slot dispatcher) — a body-callback burst is bounded in
+        # TASK count, not time, so a long budget would blind this stream
+        # to newly scheduled tasks and peer errors for the whole burst.
+        # Empty-body walks run >10M tasks/s, so the long budget still
+        # returns within ~0.5s
         if lane["callback"] is not None or self.sched.has_local_work(stream):
             budget = 4096
         else:
@@ -414,6 +431,7 @@ class Context:
             with self._ptexec_lock:
                 if self._ptexec_q and self._ptexec_q[0][1] is lane:
                     self._ptexec_q.pop(0)
+            self._ptexec_abandon(lane)
             if self._error is None:
                 self._error = e
             self._work_event.set()
@@ -427,6 +445,7 @@ class Context:
             with self._ptexec_lock:
                 if self._ptexec_q and self._ptexec_q[0][1] is lane:
                     self._ptexec_q.pop(0)
+            self._ptexec_abandon(lane)
             return True
         if graph.done():
             fin = False
@@ -441,6 +460,23 @@ class Context:
             return True
         return mine > 0
 
+    def _ptexec_abandon(self, lane: Dict[str, Any]) -> None:
+        """Drop an errored data-mode lane's slot payloads. Each stream
+        that exits the poisoned graph attempts this; the LAST one out
+        (graph idle — after a poison no worker can claim a new batch, so
+        idleness is stable) clears the payload list. Clearing earlier
+        would yank inputs out from under a peer still mid-callback;
+        leaking instead would pin every produced payload for the
+        taskpool's remaining lifetime."""
+        slots = lane.get("slots")
+        if not slots:
+            return
+        with self._ptexec_lock:
+            if lane.get("finalized") or not lane["graph"].idle():
+                return
+            lane["finalized"] = True
+        slots.clear()
+
 
     # ------------------------------------------------------------------ hot loop
     def _worker_main(self, stream: ExecutionStream) -> None:
@@ -454,8 +490,63 @@ class Context:
             self._work_event.wait(timeout=0.05)
             self._work_event.clear()
 
+    def in_progress_loop(self) -> bool:
+        """True when the CALLING thread is inside a progress loop — i.e. a
+        task body may be on its call stack. Flow-control blocking (the DTD
+        window stall) consults this: blocking mid-body can deadlock the
+        pool (the unfinished task's successors may be the only drainable
+        work). THREAD-local on purpose — all user threads share the master
+        stream object, so stream-level state would let one thread's
+        wait() mask another thread's top-level inserts (and the unlocked
+        += on a shared counter could corrupt it permanently)."""
+        return getattr(self._tls, "loop_depth", 0) > 0
+
     def _progress_loop(self, stream: ExecutionStream, until, timeout=None) -> None:
-        """The hot loop (ref: __parsec_context_wait scheduling.c:789-818)."""
+        """The hot loop (ref: __parsec_context_wait scheduling.c:789-818).
+
+        Master-stream loops are serialized (one driving thread at a time,
+        see ``_master_loop_lock``). A contender must NOT block on the
+        lock unconditionally — the holder's exit condition may require
+        the contender to make progress elsewhere (e.g. wait() holds while
+        a window-stalled inserter contends: the pool cannot complete
+        until the inserter resumes) — so contenders poll their OWN
+        ``until`` (and the error flag, and their deadline) between short
+        acquire attempts; the holder is draining the same work anyway."""
+        tls = self._tls
+        depth = getattr(tls, "loop_depth", 0)
+        tls.loop_depth = depth + 1
+        try:
+            if stream.th_id != 0:
+                self._progress_loop_inner(stream, until, timeout)
+                return
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            while True:
+                if until():
+                    return
+                if self._error is not None:
+                    raise self._error
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return
+                    slice_ = min(0.02, left)
+                else:
+                    slice_ = 0.02
+                if self._master_loop_lock.acquire(timeout=slice_):
+                    try:
+                        self._progress_loop_inner(
+                            stream, until,
+                            None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+                    finally:
+                        self._master_loop_lock.release()
+                    return
+        finally:
+            tls.loop_depth = depth
+
+    def _progress_loop_inner(self, stream: ExecutionStream, until,
+                             timeout=None) -> None:
         misses = 0
         deadline = None if timeout is None else time.monotonic() + timeout
         backoff_max = mca.get("runtime_backoff_max_us", 1000) / 1e6

@@ -6,6 +6,16 @@ produced, one slot per flow. The retire protocol mirrors the reference
 (datarepo.h:74-90): an entry carries ``usagelmt`` (how many successor uses will
 happen) and ``usagecnt`` (how many happened); when they meet, the entry retires
 and its copies drop a reference.
+
+Native-lane contract: PTG taskpools that the native execution lane accepts
+(docs/native_exec.md) never touch these repos — the SAME usagelmt/usagecnt
+protocol runs over the lane's per-task slot array inside
+``native/src/ptexec.cpp`` (``usagelmt`` = the flatten's consumer count per
+slot, the retire moment = the slot-clear in the batched callback), and
+``Graph.slot_stats()`` reports the lane-side retire counters. The parity
+harness checks both sides leave ZERO live entries at pool completion; the
+``retired`` counter below exists so that check can also see that retires
+actually happened on the Python side.
 """
 
 from __future__ import annotations
@@ -40,6 +50,7 @@ class DataRepo:
     def __init__(self, nb_flows: int, name: str = "") -> None:
         self.nb_flows = nb_flows
         self.name = name
+        self.retired = 0          # entries fully consumed and released
         self._table: Dict[Any, DataRepoEntry] = {}
         self._lock = threading.Lock()
         from ..utils.mempool import Mempool
@@ -80,6 +91,8 @@ class DataRepo:
             e.usagecnt += 1
             if e.usagelmt and e.usagecnt >= e.usagelmt and e.retained == 0:
                 retire = self._table.pop(key, None)
+                if retire is not None:
+                    self.retired += 1
         if retire is not None:
             self._release(retire)
 
@@ -94,6 +107,8 @@ class DataRepo:
             e.retained = max(0, e.retained - 1)
             if e.usagelmt and e.usagecnt >= e.usagelmt and e.retained == 0:
                 retire = self._table.pop(key, None)
+                if retire is not None:
+                    self.retired += 1
         if retire is not None:
             self._release(retire)
 
@@ -106,7 +121,9 @@ class DataRepo:
         self._pool.release(entry)
 
     def pool_stats(self) -> Dict[str, int]:
-        return self._pool.stats()
+        st = self._pool.stats()
+        st["retired"] = self.retired
+        return st
 
     def __len__(self) -> int:
         return len(self._table)

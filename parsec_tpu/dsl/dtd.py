@@ -31,6 +31,7 @@ and makes version-tracked copies natural (every write is a new buffer).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -260,6 +261,22 @@ class DTDTaskpool(Taskpool):
         self._tiles_lock = threading.Lock()
         self.window_size = mca.get("dtd_window_size", 2048)
         self.threshold_size = mca.get("dtd_threshold_size", 1024)
+        #: serializes the WHOLE insert path (ADVICE r5 medium): concurrent
+        #: user-thread inserts are an advertised contract, but the ready
+        #: buffer was the only locked piece — the tile.nid check-then-create
+        #: could mint two engine chains for one shared tile (silently
+        #: dropping RAW/WAR edges), the inserted/local_inserted RMWs could
+        #: undercount (wait() then targets too few tasks), and two
+        #: concurrently stalling inserters both drove
+        #: _progress_loop(streams[0]), racing on stream.next_task.
+        #: REENTRANT on purpose: a window-stalled inserter executes tasks
+        #: inline, and a body may itself insert (recursive task insertion).
+        #: NOT held across the window stall (see _window_stall) — blocking
+        #: a worker-thread body's insert on a stalled user thread would
+        #: deadlock; _stall_lock elects the one user thread that drives
+        #: the master stream's drain loop
+        self._insert_lock = threading.RLock()
+        self._stall_lock = threading.Lock()
         self.inserted = 0
         self.local_inserted = 0   # tasks this rank actually executes
         self.window_stalls = 0    # inserter blocked on the task window
@@ -482,14 +499,42 @@ class DTDTaskpool(Taskpool):
             self.ctx.schedule(buf)
 
     def _window_stall(self) -> None:
-        """Window flow control (ref: insert_function.h:149-157)."""
-        if self.local_inserted - self.executed > self.window_size:
-            self._flush_ready()
-            self.window_stalls += 1
-            target = self.local_inserted - self.threshold_size
-            self.ctx.start()
-            self.ctx._progress_loop(self.ctx.streams[0],
-                                    until=lambda: self.executed >= target)
+        """Window flow control (ref: insert_function.h:149-157).
+
+        Runs OUTSIDE the insert lock — a stalling inserter must never
+        block another thread's (in particular a worker-thread body's)
+        insert fast path, or a mid-body recursive insert would deadlock
+        the pool. Flow control NEVER blocks inside a task body (a thread
+        currently driving a progress loop, ``ctx.in_progress_loop()`` —
+        thread-local, so one thread's wait()/stall cannot mask another
+        thread's top-level inserts): the unfinished task's successors may
+        be the only drainable work, so waiting there can never converge —
+        recursive inserts overshoot the window instead, bounded by the
+        DAG's recursive fan-out (the reference's window also only ever
+        throttles the user-side inserter). Top-level user threads elect
+        ONE drainer via a try-lock — the loser waits for the window to
+        drain instead of racing the winner on streams[0].next_task
+        (ADVICE r5)."""
+        if self.local_inserted - self.executed <= self.window_size:
+            return
+        if self.ctx.in_progress_loop():
+            return              # mid-body insert: never block flow control
+        self._flush_ready()
+        self.window_stalls += 1
+        self.ctx.start()
+        while self.local_inserted - self.executed > self.window_size:
+            if self.ctx._error is not None:
+                return
+            if self._stall_lock.acquire(blocking=False):
+                try:
+                    target = self.local_inserted - self.threshold_size
+                    self.ctx._progress_loop(
+                        self.ctx.streams[0],
+                        until=lambda: self.executed >= target)
+                finally:
+                    self._stall_lock.release()
+                return
+            time.sleep(50e-6)   # another user thread is draining
 
     def insert_task(self, fn: Callable, *args, priority: int = 0,
                     where: int = DEV_ALL, name: Optional[str] = None,
@@ -501,7 +546,22 @@ class DTDTaskpool(Taskpool):
         the task's rank (default: first WRITE tile's rank) and/or the
         NOTRACK bit to pass the tile's value without dependency tracking
         (ref PARSEC_DONT_TRACK).
+
+        Thread-safe: concurrent user threads may insert into one pool —
+        the whole linking path (tile chain check-then-create, engine
+        calls, counters, ready buffering) runs under the taskpool insert
+        lock, so shared-tile chains stay exact; window flow control runs
+        AFTER the lock drops (one drainer elected, see _window_stall).
         """
+        with self._insert_lock:
+            task = self._insert_task_locked(fn, args, priority, where, name,
+                                            jit, batch)
+        self._window_stall()
+        return task
+
+    def _insert_task_locked(self, fn: Callable, args, priority: int,
+                            where: int, name: Optional[str],
+                            jit: bool, batch: bool) -> Optional[DTDTask]:
         if not self._open:
             output.fatal("insert_task on a closed DTD taskpool")
         if self._capture is not None:
@@ -612,9 +672,7 @@ class DTDTaskpool(Taskpool):
                     buf.append(task)
                 if len(buf) >= 1024:
                     self._flush_ready()
-            if li - self._executed > self.window_size:
-                self._window_stall()
-            return task
+            return task     # window stall runs after the insert lock drops
 
         task.lock = threading.Lock()      # Python engine: preds/release lock
         task.successors = []
@@ -654,8 +712,7 @@ class DTDTaskpool(Taskpool):
         self.addto_nb_tasks(1)
         self.local_inserted += 1
         self._drop_insertion_guard(task, schedule=True)
-        self._window_stall()
-        return task
+        return task     # window stall runs after the insert lock drops
 
     def _link_tile(self, task: DTDTask, tile: DTDTile, acc: int,
                    flow_index: int, remote: bool, distributed: bool) -> None:

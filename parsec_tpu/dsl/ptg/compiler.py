@@ -48,12 +48,25 @@ mca.register("ptg_agglomerate", True,
              "as one fused sweep at startup (no per-task "
              "scheduling cycle)", type=bool)
 mca.register("ptg_native_exec", True,
-             "Drain eligible PTG taskpools (CTL/empty-body or eager "
-             "CPU-body classes) through the native execution lane "
-             "(native/src/ptexec.cpp): the full dependency FSM runs "
-             "batched in C with the GIL dropped. Ineligible pools "
-             "fall back to the Python FSM (docs/native_exec.md)",
+             "Drain eligible PTG taskpools (CTL and DATA-flow classes "
+             "with single ungated CPU chores, incl. priorities) through "
+             "the native execution lane (native/src/ptexec.cpp): the "
+             "full dependency FSM — dep decrement, ready heap, data-slot "
+             "retire — runs batched in C with the GIL dropped. "
+             "Ineligible pools (named datatypes/reshapes, distributed "
+             "ranks, PINS, multi-chore classes) fall back to the Python "
+             "FSM (docs/native_exec.md)",
              type=bool)
+
+#: lane-engagement accounting (consumed by ci.sh's perf smoke gate and the
+#: bench). ``pools_fallback`` counts pools whose classes were ALL eligible
+#: yet the lane still declined (flatten refusal, native module missing) —
+#: the silent perf regression no throughput number reliably catches on a
+#: noisy host. ``pools_ineligible`` counts pools declined by DESIGN
+#: (ineligible class features or pool-level gates: distributed/PINS/
+#: paranoid/mca-off) — expected fallbacks, never a CI failure
+PTEXEC_STATS = {"pools_engaged": 0, "tasks_engaged": 0,
+                "pools_fallback": 0, "pools_ineligible": 0}
 
 _ACCESS_MAP = {
     P.FLOW_READ: FLOW_ACCESS_READ,
@@ -170,8 +183,10 @@ class PTGTaskpool(Taskpool):
         #: producer (consumed by prepare_input)
         self._ptg_received: Dict[Tuple, Any] = {}
         self._ptg_lock = threading.Lock()
-        #: native execution lane state (set by _startup when eligible)
+        #: native execution lane state (set by _startup when eligible) and
+        #: the decline reason ("ineligible" | "fallback" | None = engaged)
         self._ptexec_state: Optional[Dict[str, Any]] = None
+        self._ptexec_refusal: Optional[str] = None
         self._build()
         if ctx.comm is not None and ctx.nb_ranks > 1:
             # distributed PTG: global termination + name-keyed routing
@@ -845,28 +860,50 @@ class PTGTaskpool(Taskpool):
     def _ptexec_class_eligible(self, tc: TaskClass) -> bool:
         """May this class's whole FSM run inside the native lane
         (native/src/ptexec.cpp)?  Eligibility = the per-task cycle carries
-        no state the lane does not model: control-only flows (no data, no
-        repos, no reshapes), exactly one ungated CPU chore (bodies are
-        either empty or eager host Python dispatched via the batched
-        callback), no custom startup seeding, and no priority policy (the
-        lane's release order is edge-respecting, not priority-ordered)."""
-        if any(not (f.access & FLOW_ACCESS_CTL) for f in tc.flows):
-            return False
+        no state the lane does not model. The lane models: CTL edges, DATA
+        flows (the versioned slot hand-off + the datarepo usagelmt/usagecnt
+        retire protocol live in the lane's per-task slot array), memory
+        reads/write-backs, and ``priority`` properties (a native ready
+        heap). It does NOT model: named datatypes (reshape promises),
+        device/chore selection (exactly one ungated CPU chore required —
+        TPU-bodied classes carry two incarnations and stay on the Python
+        FSM), multi-body classes, or custom startup seeding. Pool-level
+        gates (distributed ranks, PINS, paranoid) live in
+        :meth:`_ptexec_prepare`."""
         if getattr(tc, "_ptg_startup_fn", None) is not None:
             return False
-        if "priority" in tc.properties:
+        if tc._ptg_spec.header_props.get("make_key_fn") is not None:
+            # a user task-key function feeds the dep repos / hash tables —
+            # machinery the lane bypasses entirely; calling (or silently
+            # not calling) a user hook is observable behavior
+            return False
+        if len(tc._ptg_spec.bodies) != 1:
             return False
         if len(tc.incarnations) != 1 or \
                 tc.incarnations[0].device_type != DEV_CPU or \
                 tc.incarnations[0].evaluate is not None:
             return False
-        if len(tc._ptg_spec.bodies) != 1:
-            return False
-        # non-empty bodies dispatch through the raw-body callback
-        if tc._ptg_spec.bodies[0].source.strip() not in ("", "pass") and \
-                getattr(tc, "_ptg_raw_body", None) is None:
-            return False
-        return True
+        has_body = tc._ptg_spec.bodies[0].source.strip() not in ("", "pass")
+        if not any(not (f.access & FLOW_ACCESS_CTL) for f in tc.flows):
+            # CTL/flowless: non-empty bodies dispatch through the raw-body
+            # callback (params only, no data marshalling)
+            return not has_body or getattr(tc, "_ptg_raw_body", None) is not None
+        # data flows: any NAMED datatype means reshape promises / typed
+        # write-backs — state that stays with the Python FSM
+        for alts in tc._ptg_in_specs:
+            for _guard, ep in alts:
+                if ep is not None and ep.get("dtt") is not None:
+                    return False
+        for f in tc.flows:
+            for dep in f.deps_out:
+                if dep.datatype is not None or \
+                        getattr(dep, "wire_datatype", None) is not None:
+                    return False
+            for mo in getattr(f, "_ptg_mem_out", None) or []:
+                if mo[3] is not None:     # (cond, dc_name, exprs, dtt_name)
+                    return False
+        # non-empty data bodies dispatch the jitted class function
+        return not has_body or getattr(tc, "_ptg_body_fn", None) is not None
 
     #: the builtins __init__ injects into env_base — identical in every
     #: instantiation, so they never enter the cache signature. Matched by
@@ -892,8 +929,14 @@ class PTGTaskpool(Taskpool):
         return (tuple(sorted(sig)), names)
 
     def _ptexec_flatten(self, classes: List[TaskClass]):
-        """Emit the flattened successor table the native lane consumes
-        (the jdf2c moment: the whole control structure leaves Python).
+        """Emit the flattened tables the native lane consumes (the jdf2c
+        moment: the whole control structure leaves Python): the CSR
+        successor table + per-task dependency goals, and — for data-flow
+        pools — each task's flow table: one data slot per (task, data
+        flow), per-slot usage limits (the repo usagelmt, counted from the
+        consumer side), input slot references resolved from the guarded
+        in-deps, memory reads (symbolic: collection name + static index,
+        resolved per pool), memory write-backs, and per-task priorities.
         Returns None when the declared in/out dep sides disagree — the
         Python FSM would mask one-sided declarations differently, so the
         lane refuses rather than diverge."""
@@ -912,17 +955,50 @@ class PTGTaskpool(Taskpool):
                 n += 1
         class_index = {tc._ptg_spec.name: ci
                        for ci, tc in enumerate(classes)}
+        # per-class data-flow tables: flow indices that carry data, in flow
+        # order (= the body's flow-argument order, _compile_body)
+        dflows_by_class = [[fi for fi, f in enumerate(tc.flows)
+                            if not (f.access & FLOW_ACCESS_CTL)]
+                           for tc in classes]
+        has_data = any(dflows_by_class)
+        has_prio = any("priority" in tc.properties for tc in classes)
+        # slot assignment: contiguous per task, one per data flow
+        slot_base = [0] * n
+        n_slots = 0
+        if has_data:
+            for ci, tc in enumerate(classes):
+                nd = len(dflows_by_class[ci])
+                for key in params_by_class[ci]:
+                    slot_base[id_of[(ci, key)]] = n_slots
+                    n_slots += nd
         goals = [0] * n
+        prio = [0] * n
         edges: List[List[int]] = [[] for _ in range(n)]
         indeg = [0] * n
+        in_refs = [-1] * n_slots    # per slot: the owning flow's input ref
+        slot_uses = [0] * n_slots   # per slot: task-kind consumer count
+        in_edges: List[List[int]] = [[] for _ in range(n)] if has_data else []
+        mem_idx_of: Dict[Tuple[str, Tuple[int, ...]], int] = {}
+        mem_reads: List[Tuple[str, Tuple[int, ...]]] = []
+        writebacks: List[Tuple[int, int, str, Tuple[int, ...]]] = []
         for ci, tc in enumerate(classes):
             params = tc._ptg_spec.params
+            prio_fn = tc.properties.get("priority")
+            dflows = dflows_by_class[ci]
             # replay the param tuples materialized above instead of
             # re-walking the range expressions (halves flatten latency)
             for key in params_by_class[ci]:
                 loc = dict(zip(params, key))
                 my_id = id_of[(ci, key)]
                 goals[my_id] = tc.dependencies_goal_fn(loc)
+                if prio_fn is not None:
+                    p = int(prio_fn(loc))
+                    if not (-(1 << 31) <= p < (1 << 31)):
+                        # the native heap is int32; the Python FSM orders
+                        # by full ints — decline rather than wrap/clamp
+                        # into a different dispatch order
+                        return None
+                    prio[my_id] = p
                 for flow in tc.flows:
                     for dep in flow.deps_out:
                         if dep.task_class is None:
@@ -944,6 +1020,53 @@ class PTGTaskpool(Taskpool):
                                 return None  # successor outside the space
                             edges[my_id].append(sid)
                             indeg[sid] += 1
+                if not dflows:
+                    continue
+                # the data side of the flow table: resolve this task's
+                # active in-dep per data flow (exactly what prepare_input
+                # does, once, at flatten instead of per dispatch)
+                env = self._env(loc)
+                base = slot_base[my_id]
+                for dj, fi in enumerate(dflows):
+                    ep = tc._ptg_active_in(tc._ptg_in_specs[fi], env)
+                    if ep is None or ep["kind"] in ("new", "null"):
+                        pass                          # ref stays -1 (no input)
+                    elif ep["kind"] == "task":
+                        si = class_index.get(ep["name"])
+                        if si is None:
+                            return None   # producer outside the lane set
+                        peer_spec = classes[si]._ptg_spec
+                        pf_idx = next(i for i, f in enumerate(peer_spec.flows)
+                                      if f.name == ep["flow"])
+                        try:
+                            pdj = dflows_by_class[si].index(pf_idx)
+                        except ValueError:
+                            return None   # data read from a CTL flow
+                        pkey = tuple(ex.values(env)[0] for ex in ep["exprs"])
+                        pid = id_of.get((si, pkey))
+                        if pid is None:
+                            return None   # producer outside the space
+                        ref = slot_base[pid] + pdj
+                        in_refs[base + dj] = ref
+                        slot_uses[ref] += 1           # the repo usagelmt
+                        in_edges[my_id].append(ref)
+                    elif ep["kind"] == "memory":
+                        idx = tuple(int(ex(env)) for ex in ep["exprs"])
+                        mk = (ep["name"], idx)
+                        mi = mem_idx_of.get(mk)
+                        if mi is None:
+                            mi = mem_idx_of[mk] = len(mem_reads)
+                            mem_reads.append(mk)
+                        in_refs[base + dj] = -2 - mi
+                    else:
+                        return None       # an endpoint kind the lane ignores
+                    mem_outs = getattr(tc.flows[fi], "_ptg_mem_out", None)
+                    if mem_outs:
+                        for cond, dc_name, exprs, _dtt in mem_outs:
+                            if not cond(loc):
+                                continue
+                            idx = tuple(int(ex(env)) for ex in exprs)
+                            writebacks.append((my_id, dj, dc_name, idx))
         if indeg != goals:
             # producer-declared edges and consumer-declared goals disagree
             output.debug_verbose(1, "ptg",
@@ -956,15 +1079,46 @@ class PTGTaskpool(Taskpool):
         succs: List[int] = []
         for e in edges:
             succs.extend(e)
-        return {"n": n, "goals": goals, "off": off, "succs": succs,
-                "bases": bases, "params": params_by_class}
+        flat = {"n": n, "goals": goals, "off": off, "succs": succs,
+                "bases": bases, "params": params_by_class,
+                "prio": prio if has_prio else None, "data": None}
+        if has_data:
+            in_off = [0] * (n + 1)
+            for i, e in enumerate(in_edges):
+                in_off[i + 1] = in_off[i] + len(e)
+            in_slots: List[int] = []
+            for e in in_edges:
+                in_slots.extend(e)
+            # per-id class index: the dispatch loop runs per TASK — a list
+            # lookup beats a bisect over the class bases at that frequency
+            cls_of: List[int] = []
+            for ci in range(len(classes)):
+                cls_of.extend([ci] * len(params_by_class[ci]))
+            flat["data"] = {
+                "slot_base": slot_base, "n_slots": n_slots,
+                "in_refs": in_refs, "slot_uses": slot_uses,
+                "in_off": in_off, "in_slots": in_slots,
+                "ndflows": [len(d) for d in dflows_by_class],
+                "dflow_idx": dflows_by_class,   # THE per-class data-flow
+                # index rule (body-argument order) — derived once, shipped
+                # to the dispatch callback instead of re-derived there
+                "cls_of": cls_of,
+                "mem_reads": mem_reads, "writebacks": writebacks,
+            }
+        return flat
 
     def _ptexec_prepare(self, agg) -> Optional[Dict[str, Any]]:
         """Build (or fetch from the program cache) the native-lane state
         for this pool, or None → the Python FSM runs as before. The fall
         back is per-pool: one ineligible class keeps cross-class release
-        edges in Python, so the whole pool stays there."""
+        edges in Python, so the whole pool stays there.
+        ``self._ptexec_refusal`` records WHY a pool declined —
+        "ineligible" (by design: class features or pool-level gates) vs
+        "fallback" (every class eligible, but the lane build refused:
+        flatten mismatch or missing native module) — feeding the
+        PTEXEC_STATS split the ci.sh gate relies on."""
         ctx = self.ctx
+        self._ptexec_refusal = "ineligible"
         if (not mca.get("ptg_native_exec", True) or ctx.nb_ranks > 1
                 or ctx.comm is not None or ctx.pins.enabled or ctx.paranoid):
             return None
@@ -976,6 +1130,7 @@ class PTGTaskpool(Taskpool):
         for tc in classes:
             if not self._ptexec_class_eligible(tc):
                 return None
+        self._ptexec_refusal = "fallback"
         from ... import native as native_mod
         mod = native_mod.load_ptexec()
         if mod is None:
@@ -990,6 +1145,7 @@ class PTGTaskpool(Taskpool):
                 return None
             if key is not None:
                 cache[key] = flat
+        self._ptexec_refusal = None
         if flat["n"] == 0:
             return {"n": 0}
         # the CSR (the expensive flatten) is shared across instantiations;
@@ -998,14 +1154,45 @@ class PTGTaskpool(Taskpool):
         # can then never walk another pool's tasks, and bodies/callbacks
         # (which resolve against THIS instantiation's globals) can never
         # cross pools. Empty bodies dispatch nothing at all.
-        graph = mod.Graph(flat["goals"], flat["off"], flat["succs"])
-        bodies = [None if tc._ptg_spec.bodies[0].source.strip()
-                  in ("", "pass") else tc._ptg_raw_body for tc in classes]
-        callback = None
-        if any(b is not None for b in bodies):
-            callback = self._mk_ptexec_callback(flat["bases"], bodies,
-                                                flat["params"])
-        return {"graph": graph, "callback": callback,
+        data = flat["data"]
+        if data is None:
+            graph = mod.Graph(flat["goals"], flat["off"], flat["succs"],
+                              flat["prio"])
+            bodies = [None if tc._ptg_spec.bodies[0].source.strip()
+                      in ("", "pass") else tc._ptg_raw_body for tc in classes]
+            callback = None
+            if any(b is not None for b in bodies):
+                callback = self._mk_ptexec_callback(flat["bases"], bodies,
+                                                    flat["params"])
+            return {"graph": graph, "callback": callback,
+                    "n": flat["n"], "finalized": False}
+        # data-flow pool: the graph additionally owns slot LIFETIMES (the
+        # usagelmt/usagecnt retire protocol); Python owns slot VALUES —
+        # one flat list the batched callback reads inputs from and lands
+        # outputs into. Memory endpoints were flattened symbolically
+        # (collection name + static index) so the cached CSR stays valid
+        # across instantiations with different collection dicts.
+        graph = mod.Graph(flat["goals"], flat["off"], flat["succs"],
+                          flat["prio"], data["in_off"], data["in_slots"],
+                          data["slot_uses"])
+        slots: List[Any] = [None] * data["n_slots"]
+        mem_datas = []
+        for dc_name, idx in data["mem_reads"]:
+            dc = self.collections.get(dc_name)
+            if dc is None:
+                output.fatal(f"PTG taskpool {self.name}: unknown "
+                             f"collection {dc_name!r}")
+            mem_datas.append(dc.data_of(*idx))
+        writebacks: Dict[int, List] = {}
+        for tid, dj, dc_name, idx in data["writebacks"]:
+            dc = self.collections.get(dc_name)
+            if dc is None:
+                output.fatal(f"PTG taskpool {self.name}: unknown "
+                             f"collection {dc_name!r}")
+            writebacks.setdefault(tid, []).append((dj, dc.data_of(*idx)))
+        callback = self._mk_ptexec_data_callback(flat, classes, slots,
+                                                 mem_datas, writebacks)
+        return {"graph": graph, "callback": callback, "slots": slots,
                 "n": flat["n"], "finalized": False}
 
     def _mk_ptexec_callback(self, bases: List[int], bodies,
@@ -1022,13 +1209,140 @@ class PTGTaskpool(Taskpool):
                     fn(*params_by_class[k][i - bases[k]])
         return run_batch
 
+    def _mk_ptexec_data_callback(self, flat, classes: List[TaskClass],
+                                 slots: List[Any], mem_datas,
+                                 writebacks: Dict[int, List]):
+        """Batched dispatch for data-flow pools — the lane's replacement
+        for generic_prepare_input + the body hook + complete_execution +
+        the repo side of generic_release_deps, amortized over one Python
+        call per ~256 ready tasks:
+
+        * inputs resolve from the slot array (producer outputs), memory
+          endpoints (``newest_copy`` at dispatch time, matching the Python
+          FSM's prepare-at-ready timing), or None (``NEW``);
+        * non-empty bodies call the class's jitted function (the same
+          object the CPU hook dispatches) — empty bodies forward inputs
+          by identity with no dispatch at all;
+        * every data flow's post-body value lands in the task's own slot
+          (data_out for written flows, forwarded data_in otherwise), then
+          memory out-deps write back and bump the data version;
+        * ``retired`` slot ids (reported by the engine once a slot's last
+          consumer body has run) drop their payload reference — the
+          entry-retire moment of core/datarepo.py, one list op instead of
+          a locked hash-table dance per use.
+        """
+        from ...data.data import COHERENCY_OWNED as _OWNED
+        bases = flat["bases"]
+        params_by_class = flat["params"]
+        data = flat["data"]
+        slot_base = data["slot_base"]
+        in_refs = data["in_refs"]
+        slot_uses = data["slot_uses"]
+        ndflows = data["ndflows"]
+        cls_of = data["cls_of"]
+        fns, written_by_class = [], []
+        for ci, tc in enumerate(classes):
+            empty = tc._ptg_spec.bodies[0].source.strip() in ("", "pass")
+            if ndflows[ci]:
+                fns.append(None if empty else tc._ptg_body_fn)
+                written_by_class.append(tuple(
+                    dj for dj, fi in enumerate(data["dflow_idx"][ci])
+                    if tc.flows[fi].access & FLOW_ACCESS_WRITE))
+            else:
+                fns.append(None if empty else tc._ptg_raw_body)
+                written_by_class.append(())
+        # single-data-flow classes whose flow is WRITTEN are the hot shape
+        # (RW chains); the dispatch loop specializes them. A READ-only
+        # single flow must take the general path: its body returns an
+        # EMPTY written tuple and the flow forwards the input unchanged
+        single = [nd == 1 and w == (0,)
+                  for nd, w in zip(ndflows, written_by_class)]
+
+        def _null_guard(k, i):
+            raise RuntimeError(
+                f"A NULL is forwarded from {classes[k]._ptg_spec.name}"
+                f"{tuple(params_by_class[k][i - bases[k]])} (native lane)")
+
+        def run_batch(ids, retired):
+            # locals: this loop runs once per TASK of every data pool
+            _slots, _refs, _uses = slots, in_refs, slot_uses
+            _base, _cls, _wb = slot_base, cls_of, writebacks
+            for j in retired:
+                _slots[j] = None          # the entry-retire moment
+            for i in ids:
+                k = _cls[i]
+                fn = fns[k]
+                nd = ndflows[k]
+                if nd == 0:               # CTL class riding a data pool
+                    if fn is not None:
+                        fn(*params_by_class[k][i - bases[k]])
+                    continue
+                base = _base[i]
+                if single[k]:
+                    r = _refs[base]
+                    if r >= 0:
+                        v = _slots[r]
+                    elif r == -1:
+                        v = None
+                    else:
+                        copy = mem_datas[-2 - r].newest_copy()
+                        v = None if copy is None else copy.payload
+                    if fn is not None:
+                        v = fn(*params_by_class[k][i - bases[k]], v)[0]
+                    if v is None and _uses[base] > 0:
+                        _null_guard(k, i)    # parsec.c:1879 source guard
+                    _slots[base] = v
+                    wbs = _wb.get(i)
+                    if wbs is None:
+                        continue
+                    vals = (v,)
+                else:
+                    vals = []
+                    for dj in range(nd):
+                        r = _refs[base + dj]
+                        if r >= 0:
+                            vals.append(_slots[r])
+                        elif r == -1:
+                            vals.append(None)
+                        else:
+                            copy = mem_datas[-2 - r].newest_copy()
+                            vals.append(None if copy is None
+                                        else copy.payload)
+                    if fn is not None:
+                        outs = fn(*params_by_class[k][i - bases[k]], *vals)
+                        for oj, dj in enumerate(written_by_class[k]):
+                            vals[dj] = outs[oj]
+                    for dj in range(nd):
+                        v = vals[dj]
+                        if v is None and _uses[base + dj] > 0:
+                            _null_guard(k, i)
+                        _slots[base + dj] = v
+                    wbs = _wb.get(i)
+                    if wbs is None:
+                        continue
+                for dj, dref in wbs:
+                    v = vals[dj]
+                    host = dref.get_copy(0)
+                    if host is None:
+                        dref.create_copy(0, v, _OWNED)
+                    else:
+                        host.payload = v
+                    dref.bump_version(0)
+        return run_batch
+
     def _ptexec_finalize(self, lane: Dict[str, Any]) -> None:
         """Called exactly once (by whichever stream drains the graph last)
         after every lane task executed: retire the task accounting in one
-        step — the per-task complete/release cycle already ran in C."""
+        step — the per-task complete/release cycle already ran in C — and
+        drop the remaining slot payloads (terminal outputs were already
+        written back by the callback; slots the last release sweep retired
+        never met another dispatch to clear them)."""
         output.debug_verbose(2, "ptg",
                              f"{self.name}: native lane retired "
                              f"{lane['n']} tasks")
+        slots = lane.get("slots")
+        if slots:
+            slots.clear()
         self.addto_nb_tasks(-lane["n"])
 
     # ------------------------------------------------------------------ startup
@@ -1043,8 +1357,12 @@ class PTGTaskpool(Taskpool):
         for name in agg:
             self._agglomerated += self._run_agglomerated(
                 stream, self._classes[name])
+        nonagg = any(tcs.name not in agg
+                     for tcs in self.program.spec.task_classes)
         lane = self._ptexec_prepare(agg)
         if lane is not None:
+            PTEXEC_STATS["pools_engaged"] += 1
+            PTEXEC_STATS["tasks_engaged"] += lane["n"]
             self._ptexec_state = lane
             self.set_nb_tasks(lane["n"])
             if lane["n"]:
@@ -1053,6 +1371,11 @@ class PTGTaskpool(Taskpool):
                                  f"{self.name}: {lane['n']} tasks on the "
                                  f"native execution lane")
             return []
+        if nonagg and mca.get("ptg_native_exec", True):
+            if self._ptexec_refusal == "fallback":
+                PTEXEC_STATS["pools_fallback"] += 1
+            else:
+                PTEXEC_STATS["pools_ineligible"] += 1
         for tcs in self.program.spec.task_classes:
             if tcs.name in agg:
                 continue        # executed above, never scheduled/counted

@@ -369,18 +369,27 @@ def test_mempool_thread_affine_roundtrip():
 def test_datarepo_entries_are_pooled(ctx):
     """Repo entries recycle through the mempool WITHIN a run (repos — and
     their pools — are per-taskpool, so each run exercises a fresh pool;
-    the loop re-checks the property holds from a fresh state)."""
+    the loop re-checks the property holds from a fresh state). Lane OFF:
+    this exercises the Python FSM's repo machinery — the native execution
+    lane bypasses repos entirely (its slot retire counters are covered by
+    tests/test_ptexec.py)."""
     from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    from parsec_tpu.utils import mca
     src = ("%global N\nS(i)\n  i = 0 .. N-1\n  WRITE X -> X C(i)\n"
            "BODY\n  X = np.ones((2, 2), np.float32) * i\nEND\n\n"
            "C(i)\n  i = 0 .. N-1\n  RW X <- X S(i)\nBODY\n  X = X + 1\nEND\n")
     prog = compile_ptg(src, "pool")
-    for r in range(3):
-        tp = prog.instantiate(ctx, globals={"N": 8}, collections={},
-                              name=f"pool{r}")
-        ctx.add_taskpool(tp)
-        ctx.wait(timeout=30)
-        repo = tp.repos[tp._classes["S"].task_class_id]
-        assert len(repo) == 0                       # all retired
-        st = repo.pool_stats()
-        assert st["constructed"] <= 8 and st["free"] >= 1
+    mca.set("ptg_native_exec", False)
+    try:
+        for r in range(3):
+            tp = prog.instantiate(ctx, globals={"N": 8}, collections={},
+                                  name=f"pool{r}")
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=30)
+            repo = tp.repos[tp._classes["S"].task_class_id]
+            assert len(repo) == 0                       # all retired
+            assert repo.retired == 8
+            st = repo.pool_stats()
+            assert st["constructed"] <= 8 and st["free"] >= 1
+    finally:
+        mca.params.unset("ptg_native_exec")

@@ -262,6 +262,44 @@ def test_native_lane_concurrent_inserters(ctx):
     assert total == nthreads * per_thread, total
 
 
+def test_native_lane_concurrent_inserters_shared_tiles(ctx):
+    """TWO user threads insert RW tasks on the SAME tiles concurrently
+    (ADVICE r5 medium: the real contract, not just disjoint tiles). The
+    taskpool insert lock must serialize tile chain linking — without it
+    the tile.nid check-then-create can mint two engine chains for one
+    tile and silently drop RAW/WAR edges — and keep the inserted /
+    local_inserted counters exact so wait() targets every task."""
+    import threading
+
+    tp = DTDTaskpool(ctx, "ncs")
+    per_thread, nthreads = 1500, 3
+    shared = [tp.tile_new((2, 2), np.float32) for _ in range(4)]
+    for t in shared:
+        t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    barrier = threading.Barrier(nthreads)
+
+    def inserter(tid):
+        barrier.wait()          # maximize interleaving on the same chains
+        for i in range(per_thread):
+            tp.insert_task(lambda a: a + 1.0, (shared[(tid + i) % 4], RW),
+                           jit=False, name=f"S{tid}")
+
+    threads = [threading.Thread(target=inserter, args=(t,))
+               for t in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tp.inserted == tp.local_inserted == nthreads * per_thread
+    tp.wait(timeout=120)
+    tp.close()
+    ctx.wait(timeout=60)
+    total = sum(float(np.asarray(t.data.newest_copy().payload)[0, 0])
+                for t in shared)
+    assert total == nthreads * per_thread, total
+    assert tp.executed == nthreads * per_thread
+
+
 def test_native_lane_activation_race_with_live_workers():
     """Regression (ADVICE.md r5 high, dtd.py:590): with worker threads
     LIVE during insertion, a fast predecessor completing in the gap
@@ -292,6 +330,73 @@ def test_native_lane_activation_race_with_live_workers():
         assert total == n, total
     finally:
         c.fini()
+
+
+def test_insert_from_worker_body_under_window_pressure():
+    """A task BODY that itself inserts (recursive insertion) while a user
+    thread is window-stalled must not deadlock: the insert lock is not
+    held across the stall, and a worker-thread inserter drains on its own
+    stream instead of blocking on the user thread's stall."""
+    from parsec_tpu.utils import mca
+
+    mca.set("dtd_window_size", 16)
+    mca.set("dtd_threshold_size", 8)
+    c = pt.Context(nb_cores=2)
+    try:
+        tp = DTDTaskpool(c, "rec")
+        c.start()                    # workers live: bodies run on them too
+        parent_t = tp.tile_new((2, 2), np.float32)
+        child_t = tp.tile_new((2, 2), np.float32)
+        parent_t.data.create_copy(0, np.zeros((2, 2), np.float32))
+        child_t.data.create_copy(0, np.zeros((2, 2), np.float32))
+        n = 200
+
+        def parent(a):
+            tp.insert_task(lambda b: b + 1.0, (child_t, RW), jit=False,
+                           name="child")
+            return a + 1.0
+
+        for _ in range(n):
+            tp.insert_task(parent, (parent_t, RW), jit=False, name="parent")
+        assert tp.wait(timeout=120), "pool wedged (stall deadlock?)"
+        tp.close()
+        c.wait(timeout=60)
+        assert float(np.asarray(
+            parent_t.data.newest_copy().payload)[0, 0]) == n
+        assert float(np.asarray(
+            child_t.data.newest_copy().payload)[0, 0]) == n
+        assert tp.executed == 2 * n
+    finally:
+        mca.params.unset("dtd_window_size")
+        mca.params.unset("dtd_threshold_size")
+        c.fini()
+
+
+def test_in_progress_loop_is_thread_local(ctx):
+    """The mid-body marker that bypasses window flow control must be
+    per-THREAD: all user threads share the master stream object, so
+    stream-level state would let one thread's wait() silently disable
+    another thread's window throttling (and an unlocked shared counter
+    could corrupt permanently)."""
+    import threading
+
+    inside = []
+    done = threading.Event()
+
+    def spinner():
+        ctx._tls.loop_depth = 1       # this thread "is" inside a loop
+        inside.append(ctx.in_progress_loop())
+        done.wait(5)
+
+    t = threading.Thread(target=spinner)
+    t.start()
+    try:
+        for _ in range(100):
+            assert not ctx.in_progress_loop()   # main thread unaffected
+    finally:
+        done.set()
+        t.join()
+    assert inside == [True]
 
 
 def test_native_lane_window_pressure(ctx):
