@@ -719,26 +719,87 @@ def main() -> None:
         results.pop("tasks_per_sec_dataflow_native", None)
     persist("after EP rate")
 
-    # DTD dynamic-insert rate on the same graph shape
+    # DTD dynamic-insert rate on the same graph shape. HONEST KEYS
+    # (ISSUE 4): the batched native lane (the default on this context
+    # shape) reports under `dtd_insert_tasks_per_sec_native`; the
+    # retained per-task engine baseline — the exact r1-r5
+    # `dtd_insert_tasks_per_sec` path — keeps BOTH the historical key and
+    # the explicit `dtd_insert_tasks_per_sec_python_engine`. Modes
+    # INTERLEAVE round-robin and take best-of-N: this container's CPU
+    # throttles in bursts, so back-to-back same-mode reps would hand one
+    # mode a whole throttle window and skew the ratio either way.
+    import threading as _threading
+
+    from parsec_tpu.dsl.dtd import PTDTD_STATS as _dtd_stats
     from parsec_tpu.dsl.dtd import READ as pt_READ
 
     def _ep_body(x):
         return None
 
-    dtd_rate = 0.0
-    for _ in range(2):
+    def dtd_insert_rate(nthreads: int = 1) -> float:
         tp = DTDTaskpool(ctx, "ep")
         # READ access on writer-less tiles = fully independent tasks (the
         # reference EP graph); RW would serialize into per-tile WAW chains
-        tiles = [tp.tile_new((2, 2)) for _ in range(64)]
-        t0 = time.perf_counter()
-        for i in range(ntasks):
-            tp.insert_task(_ep_body, (tiles[i % 64], pt_READ), jit=False,
-                           name="EP")
+        tiles = [tp.tile_new((2, 2)) for _ in range(64 * nthreads)]
+        if nthreads == 1:
+            t0 = time.perf_counter()
+            for i in range(ntasks):
+                tp.insert_task(_ep_body, (tiles[i % 64], pt_READ),
+                               jit=False, name="EP")
+        else:
+            barrier = _threading.Barrier(nthreads + 1)
+
+            def _ins(k):
+                mine = tiles[64 * k:64 * (k + 1)]
+                barrier.wait()
+                for i in range(ntasks):
+                    tp.insert_task(_ep_body, (mine[i % 64], pt_READ),
+                                   jit=False, name="EP")
+
+            threads = [_threading.Thread(target=_ins, args=(k,))
+                       for k in range(nthreads)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
         tp.wait(); tp.close(); ctx.wait()
-        dtd_rate = max(dtd_rate, ntasks / (time.perf_counter() - t0))
-    log(f"EP via DTD insert_task: {dtd_rate:,.0f} tasks/s")
-    results["dtd_insert_tasks_per_sec"] = round(dtd_rate)
+        return ntasks * nthreads / (time.perf_counter() - t0)
+
+    dtd_native = dtd_engine = 0.0
+    batched0 = _dtd_stats["tasks_batched"]
+    for _ in range(4):   # best-of-4: throttle bursts swamp any single rep
+        dtd_native = max(dtd_native, dtd_insert_rate())
+        _mca.set("dtd_batch_insert", False)
+        try:
+            dtd_engine = max(dtd_engine, dtd_insert_rate())
+        finally:
+            _mca.params.unset("dtd_batch_insert")
+    if _dtd_stats["tasks_batched"] > batched0:
+        results["dtd_insert_tasks_per_sec_native"] = round(dtd_native)
+        log(f"EP via DTD insert_task (batched native lane): "
+            f"{dtd_native:,.0f} tasks/s")
+    else:  # never leave a fallback measurement behind a *_native key
+        log("DTD batch lane did not engage; native key withheld")
+    results["dtd_insert_tasks_per_sec_python_engine"] = round(dtd_engine)
+    results["dtd_insert_tasks_per_sec"] = round(dtd_engine)
+    log(f"EP via DTD insert_task (per-task engine): "
+        f"{dtd_engine:,.0f} tasks/s")
+
+    # inserter-thread scaling sweep (batched lane): spec-building is a
+    # GIL-atomic buffer append and linking runs GIL-free in insert_many,
+    # so concurrent user inserters should aggregate instead of convoying.
+    # Same honesty gate as the *_native key: per-task-engine runs must
+    # never be presented as batched-lane scaling data
+    if _dtd_stats["tasks_batched"] > batched0:
+        try:
+            sweep = {str(nth): round(dtd_insert_rate(nth))
+                     for nth in (1, 2, 4)}
+            results["dtd_insert_scaling_by_threads"] = sweep
+            log(f"DTD inserter-thread sweep: {sweep}")
+        except Exception as e:  # noqa: BLE001 — never blocks the run
+            log(f"DTD inserter sweep unavailable: {e}")
     ctx.fini()
 
     # process-per-chip scaling (the framework's official scale-out unit:
@@ -904,7 +965,10 @@ def main() -> None:
         # and DTD-cycle bases keep reporting under their own suffixed keys
         # so the r1-r5 trajectory stays readable (r5's crossover_ts_sched
         # was DTD-based and is continued by crossover_ts_dtd)
-        dtd_overhead_s = 1.0 / dtd_rate            # full DTD cycle, 1 task
+        # full DTD cycle, 1 task — the PER-TASK ENGINE base (r1-r5
+        # continuity for crossover_ts_dtd; the batched lane reports under
+        # its own _dtd_native suffix below)
+        dtd_overhead_s = 1.0 / dtd_engine
         native_sched = results.get("tasks_per_sec_scheduled_native", 0)
         pyfsm_sched = results.get("tasks_per_sec_scheduled", 0)
         sched_overhead_s = 1.0 / native_sched if native_sched \
@@ -918,6 +982,9 @@ def main() -> None:
         df_native = results.get("tasks_per_sec_dataflow_native", 0)
         if df_native:
             env["per_task_overhead_us_dataflow"] = round(1e6 / df_native, 2)
+        dtd_nat = results.get("dtd_insert_tasks_per_sec_native", 0)
+        if dtd_nat:
+            env["per_task_overhead_us_dtd_native"] = round(1e6 / dtd_nat, 2)
         if chip_gflops:
             def _xover(overhead_s):
                 return round((overhead_s * chip_gflops * 1e9 / 2.0)
@@ -925,6 +992,8 @@ def main() -> None:
             env["achieved_gflops_basis"] = chip_gflops
             env["crossover_ts_sched"] = _xover(sched_overhead_s)
             env["crossover_ts_dtd"] = _xover(dtd_overhead_s)
+            if dtd_nat:
+                env["crossover_ts_dtd_native"] = _xover(1.0 / dtd_nat)
             if pyfsm_sched:
                 env["crossover_ts_sched_pyfsm"] = _xover(1.0 / pyfsm_sched)
             if df_native:

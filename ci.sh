@@ -70,6 +70,37 @@ ctx.fini()
 print(f"native lane engagement OK: {PTEXEC_STATS}")
 EOF
 
+echo "== DTD batched lane engagement smoke =="
+# same contract as the ptexec gate: assert ENGAGEMENT COUNTERS, not
+# throughput — a silent per-task fallback on an eligible insert stream
+# (the 10x regression) fails deterministically on any host speed
+JAX_PLATFORMS=cpu timeout 120 python3 - <<'EOF'
+import numpy as np
+import parsec_tpu as pt
+from parsec_tpu.dsl.dtd import DTDTaskpool, PTDTD_STATS, RW
+
+def inc(a):
+    return a + 1.0
+
+ctx = pt.Context(nb_cores=1)
+tp = DTDTaskpool(ctx, "ci-dtd")
+tiles = [tp.tile_new((2, 2), np.float32) for _ in range(8)]
+for t in tiles:
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+for i in range(512):
+    tp.insert_task(inc, (tiles[i % 8], RW), jit=False)
+tp.wait(timeout=60); tp.close(); ctx.wait(timeout=60)
+assert PTDTD_STATS["pools_batch"] >= 1, PTDTD_STATS
+# one per-task insert registers the class; the rest must ride the batch
+assert PTDTD_STATS["tasks_batched"] >= 500, PTDTD_STATS
+assert PTDTD_STATS["tasks_per_task"] <= 8, PTDTD_STATS
+for t in tiles:
+    assert float(np.asarray(t.data.newest_copy().payload)[0, 0]) == 64.0, \
+        "batched RW chains lost writes"
+ctx.fini()
+print(f"DTD batched lane engagement OK: {PTDTD_STATS}")
+EOF
+
 echo "== byte-compile lint (syntax over the whole tree) =="
 python3 -m compileall -q parsec_tpu tests examples benchmarks bench.py \
     __graft_entry__.py setup.py

@@ -13,20 +13,50 @@
 // a C-extension method call costs ~0.2 us (measured in this container —
 // see parsec_tpu/native.py's docstring for the ctypes numbers).
 //
+// TWO LANES share the chain state:
+//
+//  * the per-task lane (insert/activate/complete) — one C call per task,
+//    ids surfaced to Python, which owns the task objects and runs bodies
+//    through the ordinary scheduling FSM. v1 of this engine.
+//  * the BATCHED lane (register_class/insert_many/drain_ready) — the
+//    whole insert->link->ready->execute->release cycle stays inside the
+//    engine in batches. insert_many() links N tasks under ONE GIL drop
+//    (the count-then-activate protocol per task is preserved: the guard
+//    is held across the link and dropped only once the task is fully
+//    recorded — with the engine mutex held for the whole batch, a
+//    concurrent complete() can never observe a half-linked task).
+//    Ready batch-lane tasks never surface to Python as ids: drain_ready()
+//    pops them, gathers their flow payloads from the per-tile payload
+//    slots (Python owns the VALUES, C owns the slot lifetimes — the
+//    ptexec data-mode split), invokes the class's batched callback once
+//    per (class, batch), lands the written payloads back into the tile
+//    slots, and feeds the release walk directly back into the ready
+//    structure. Only per-task-lane successors released by a batch
+//    completion come back to Python (the `surfaced` tuple).
+//
 // Scope: the SINGLE-RANK engine. Distributed inserts, the replay auditor,
 // and remote version bookkeeping stay in the Python engine (dsl/dtd.py
 // _link_tile) — they are protocol-bound, not insert-rate-bound. The Python
-// side gates which engine a taskpool uses (DTDTaskpool._native_engine).
+// side gates which engine (and which lane) a taskpool uses.
 //
-// Concurrency: every entry point runs under the GIL (worker threads call
-// complete() from Python), which serializes access; no internal locks.
-// Task/tile records live in growing arrays; ids are indices and are never
-// recycled (a completed task id may persist as a tile's last_writer).
+// Concurrency: chain/task/tile/ready state is guarded by an internal
+// mutex (v1 relied on the GIL; insert_many drops the GIL for the link
+// walk, so concurrent inserter threads now scale on real cores and every
+// entry point locks). Python OBJECT references (tile payload slots, task
+// value tuples, class callbacks) are only created/destroyed while the
+// GIL is held; INCREFs may happen under the mutex but DECREFs (which can
+// run arbitrary __del__) and allocations are always deferred until the
+// mutex is released, so a finalizer can never re-enter the engine under
+// its own lock. Task/tile records live in growing arrays; ids are
+// indices and are never recycled (a completed task id may persist as a
+// tile's last_writer).
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <cstdint>
+#include <mutex>
 #include <new>
 #include <vector>
 
@@ -35,10 +65,16 @@ namespace {
 constexpr int32_t ACC_READ = 0x1;    // mirrors dsl/dtd.py READ
 constexpr int32_t ACC_WRITE = 0x2;   // mirrors dsl/dtd.py WRITE
 
+constexpr Py_ssize_t PT_FLOWS_MAX = 64;
+
 struct TaskRec {
     int32_t deps_remaining = 1;   // the insertion-in-progress guard
     bool completed = false;
     uint32_t stamp = 0;           // pred-dedup visit stamp
+    int32_t cls = -1;             // batch-lane class id (-1: per-task lane)
+    int64_t flow_off = 0;         // into the flow arena (batch lane only)
+    int32_t flow_n = 0;
+    PyObject *vals = nullptr;     // by-value args tuple (batch lane, owned)
     std::vector<int64_t> succs;
 };
 
@@ -46,24 +82,50 @@ struct TileRec {
     int64_t last_writer = -1;
     int32_t compact_at = 32;      // reader-list compaction watermark
     std::vector<int64_t> readers;
+    PyObject *payload = nullptr;  // batch-lane payload slot (owned)
+    int64_t writes = 0;           // batch-lane writes since last slot_sync
+};
+
+struct ClassRec {
+    PyObject *cb = nullptr;            // batched callback (owned)
+    PyObject *retire = nullptr;        // post-landing accounting cb (owned)
+    std::vector<int32_t> argmap;       // body arg -> flow index, -1 = value
+    std::vector<int32_t> accs;         // per-flow access bits
+    int32_t nvals = 0;                 // count of -1 entries in argmap
+    int32_t nwrites = 0;               // count of WRITE flows
 };
 
 struct Engine {
     PyObject_HEAD
+    std::mutex *mu;               // guards everything below except refcounts
     std::vector<TaskRec> *tasks;
     std::vector<TileRec> *tiles;
+    std::vector<ClassRec> *classes;
+    std::vector<int64_t> *flow_tile;   // batch-lane flow arena
+    std::vector<int64_t> *flow_acc;
+    std::vector<int64_t> *ready;       // ready batch-lane task ids (LIFO)
     uint32_t stamp;
     int64_t live;                 // inserted - completed
+    int64_t batch_done;           // batch-lane tasks executed (diagnostics)
+    bool poisoned;                // a batch callback raised
 };
 
 PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
     Engine *self = reinterpret_cast<Engine *>(type->tp_alloc(type, 0));
     if (!self) return nullptr;
+    self->mu = new (std::nothrow) std::mutex();
     self->tasks = new (std::nothrow) std::vector<TaskRec>();
     self->tiles = new (std::nothrow) std::vector<TileRec>();
+    self->classes = new (std::nothrow) std::vector<ClassRec>();
+    self->flow_tile = new (std::nothrow) std::vector<int64_t>();
+    self->flow_acc = new (std::nothrow) std::vector<int64_t>();
+    self->ready = new (std::nothrow) std::vector<int64_t>();
     self->stamp = 0;
     self->live = 0;
-    if (!self->tasks || !self->tiles) {
+    self->batch_done = 0;
+    self->poisoned = false;
+    if (!self->mu || !self->tasks || !self->tiles || !self->classes ||
+        !self->flow_tile || !self->flow_acc || !self->ready) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -73,20 +135,40 @@ PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
 
 void engine_dealloc(PyObject *obj) {
     Engine *self = reinterpret_cast<Engine *>(obj);
+    if (self->tasks)
+        for (auto &t : *self->tasks) Py_XDECREF(t.vals);
+    if (self->tiles)
+        for (auto &t : *self->tiles) Py_XDECREF(t.payload);
+    if (self->classes)
+        for (auto &c : *self->classes) {
+            Py_XDECREF(c.cb);
+            Py_XDECREF(c.retire);
+        }
+    delete self->mu;
     delete self->tasks;
     delete self->tiles;
+    delete self->classes;
+    delete self->flow_tile;
+    delete self->flow_acc;
+    delete self->ready;
     Py_TYPE(obj)->tp_free(obj);
 }
 
-// tile() -> int : register a new tile chain
+// tile() -> int : register a new tile chain (payload slot starts empty)
 PyObject *engine_tile(PyObject *obj, PyObject *) {
     Engine *self = reinterpret_cast<Engine *>(obj);
-    self->tiles->emplace_back();
-    return PyLong_FromSsize_t((Py_ssize_t)self->tiles->size() - 1);
+    Py_ssize_t nid;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        self->tiles->emplace_back();
+        nid = (Py_ssize_t)self->tiles->size() - 1;
+    }
+    return PyLong_FromSsize_t(nid);
 }
 
-// insert(tile_ids: list|tuple[int], accs: list|tuple[int])
-//   -> (task_id, deps_remaining)   — the insertion guard is STILL HELD
+// The chain-link walk shared by both lanes. MUST be called with mu held.
+// Links one task's flows into the tile chains and returns its id with the
+// insertion guard STILL HELD (deps_remaining = 1 + discovered preds).
 //
 // Replicates dsl/dtd.py _link_tile single-rank semantics exactly:
 //   READ (or access without WRITE): RAW pred on the live last writer;
@@ -96,62 +178,13 @@ PyObject *engine_tile(PyObject *obj, PyObject *) {
 //     the tile chain then points at this task and the reader list resets.
 // Preds are deduplicated (visit stamps) and self-edges skipped; each live
 // pred gains a successor edge and bumps this task's dep count.
-//
-// The insertion guard (count starts at 1) is NOT dropped here: the caller
-// must publish its id->task bookkeeping and then call activate(task_id),
-// which drops the guard — the count-then-activate protocol of
-// parsec_dtd_schedule_task_if_ready (insert_function.c:2963). Dropping
-// the guard inside insert() would let a fast predecessor completing on a
-// worker thread surface this id from complete() BEFORE the inserting
-// thread has mapped it (the round-5 activation race, ADVICE.md).
-PyObject *engine_insert(PyObject *obj, PyObject *args) {
-    Engine *self = reinterpret_cast<Engine *>(obj);
-    PyObject *tile_ids, *accs;
-    if (!PyArg_ParseTuple(args, "OO", &tile_ids, &accs))
-        return nullptr;
-    // lists are what the hot caller builds; accept tuples too
-    const bool til = PyList_Check(tile_ids), acl = PyList_Check(accs);
-    if ((!til && !PyTuple_Check(tile_ids)) ||
-        (!acl && !PyTuple_Check(accs))) {
-        PyErr_SetString(PyExc_TypeError, "tile_ids/accs: list or tuple");
-        return nullptr;
-    }
-    Py_ssize_t nflows = til ? PyList_GET_SIZE(tile_ids)
-                            : PyTuple_GET_SIZE(tile_ids);
-    if ((acl ? PyList_GET_SIZE(accs) : PyTuple_GET_SIZE(accs)) != nflows) {
-        PyErr_SetString(PyExc_ValueError, "tile_ids/accs length mismatch");
-        return nullptr;
-    }
-
+int64_t link_locked(Engine *self, const int64_t *tixs, const int64_t *laccs,
+                    Py_ssize_t nflows) {
     std::vector<TaskRec> &tasks = *self->tasks;
     std::vector<TileRec> &tiles = *self->tiles;
-
-    // validate EVERYTHING before mutating any chain state: a mid-loop
-    // failure after linking flow 0 would leave successor edges (and
-    // possibly tile.last_writer) pointing at a popped — soon reused — id
-    constexpr Py_ssize_t PT_FLOWS_MAX = 64;
-    if (nflows > PT_FLOWS_MAX) {
-        PyErr_SetString(PyExc_ValueError, "too many flows (max 64)");
-        return nullptr;
-    }
-    int64_t tixs[PT_FLOWS_MAX];
-    long laccs[PT_FLOWS_MAX];
-    for (Py_ssize_t i = 0; i < nflows; i++) {
-        tixs[i] = PyLong_AsLongLong(
-            til ? PyList_GET_ITEM(tile_ids, i)
-                : PyTuple_GET_ITEM(tile_ids, i));
-        laccs[i] = PyLong_AsLong(acl ? PyList_GET_ITEM(accs, i)
-                                     : PyTuple_GET_ITEM(accs, i));
-        if (!PyErr_Occurred() &&
-            (tixs[i] < 0 || (size_t)tixs[i] >= tiles.size()))
-            PyErr_SetString(PyExc_IndexError, "bad tile id");
-        if (PyErr_Occurred()) return nullptr;
-    }
-
     const int64_t tid = (int64_t)tasks.size();
     tasks.emplace_back();
     self->live++;
-    // note: emplace may reallocate; take references AFTER any growth
     if (++self->stamp == 0) {     // stamp wrapped: clear all (rare)
         for (auto &t : tasks) t.stamp = 0;
         self->stamp = 1;
@@ -161,7 +194,7 @@ PyObject *engine_insert(PyObject *obj, PyObject *args) {
 
     for (Py_ssize_t i = 0; i < nflows; i++) {
         int64_t tix = tixs[i];
-        long acc = laccs[i];
+        int64_t acc = laccs[i];
         TileRec &tile = tiles[(size_t)tix];
         const bool is_read = (acc & ACC_READ) || !(acc & ACC_WRITE);
         if (is_read) {
@@ -186,9 +219,6 @@ PyObject *engine_insert(PyObject *obj, PyObject *args) {
             }
         }
         if (acc & ACC_WRITE) {
-            if (acc & ACC_READ) {       // RW also joined RAW above; reader
-                // list membership is superseded by becoming the writer
-            }
             for (int64_t r : tile.readers) {
                 if (r == tid) continue;
                 TaskRec &rr = tasks[(size_t)r];
@@ -212,10 +242,97 @@ PyObject *engine_insert(PyObject *obj, PyObject *args) {
             tile.compact_at = 32;
         }
     }
+    tasks[(size_t)tid].deps_remaining += new_deps;   // guard still held
+    return tid;
+}
 
+// The release walk shared by both lanes. MUST be called with mu held.
+// Marks `tid` completed and decrements its successors; newly-ready
+// batch-lane successors go straight onto the internal ready structure,
+// newly-ready per-task-lane successors are appended to `surfaced` for
+// Python to schedule.
+void complete_locked(Engine *self, int64_t tid,
+                     std::vector<int64_t> &surfaced) {
+    std::vector<TaskRec> &tasks = *self->tasks;
     TaskRec &rec = tasks[(size_t)tid];
-    rec.deps_remaining += new_deps;                  // guard still held
-    return Py_BuildValue("(Li)", (long long)tid, (int)rec.deps_remaining);
+    rec.completed = true;
+    self->live--;
+    // move out the successor list so the record sheds its heap storage
+    std::vector<int64_t> succs;
+    succs.swap(rec.succs);
+    for (int64_t s : succs) {
+        TaskRec &sr = tasks[(size_t)s];
+        if (--sr.deps_remaining == 0) {
+            if (sr.cls >= 0)
+                self->ready->push_back(s);
+            else
+                surfaced.push_back(s);
+        }
+    }
+}
+
+// insert(tile_ids: list|tuple[int], accs: list|tuple[int])
+//   -> (task_id, deps_remaining)   — the insertion guard is STILL HELD
+//
+// The per-task lane. The insertion guard (count starts at 1) is NOT
+// dropped here: the caller must publish its id->task bookkeeping and then
+// call activate(task_id), which drops the guard — the count-then-activate
+// protocol of parsec_dtd_schedule_task_if_ready (insert_function.c:2963).
+// Dropping the guard inside insert() would let a fast predecessor
+// completing on a worker thread surface this id from complete() BEFORE
+// the inserting thread has mapped it (the round-5 activation race,
+// ADVICE.md).
+PyObject *engine_insert(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *tile_ids, *accs;
+    if (!PyArg_ParseTuple(args, "OO", &tile_ids, &accs))
+        return nullptr;
+    // lists are what the hot caller builds; accept tuples too
+    const bool til = PyList_Check(tile_ids), acl = PyList_Check(accs);
+    if ((!til && !PyTuple_Check(tile_ids)) ||
+        (!acl && !PyTuple_Check(accs))) {
+        PyErr_SetString(PyExc_TypeError, "tile_ids/accs: list or tuple");
+        return nullptr;
+    }
+    Py_ssize_t nflows = til ? PyList_GET_SIZE(tile_ids)
+                            : PyTuple_GET_SIZE(tile_ids);
+    if ((acl ? PyList_GET_SIZE(accs) : PyTuple_GET_SIZE(accs)) != nflows) {
+        PyErr_SetString(PyExc_ValueError, "tile_ids/accs length mismatch");
+        return nullptr;
+    }
+
+    // validate EVERYTHING before mutating any chain state: a mid-loop
+    // failure after linking flow 0 would leave successor edges (and
+    // possibly tile.last_writer) pointing at a popped — soon reused — id
+    if (nflows > PT_FLOWS_MAX) {
+        PyErr_SetString(PyExc_ValueError, "too many flows (max 64)");
+        return nullptr;
+    }
+    int64_t tixs[PT_FLOWS_MAX];
+    int64_t laccs[PT_FLOWS_MAX];
+    // tiles->size() is read under the GIL without mu: tile ids only grow,
+    // and a tile referenced here was necessarily created before this call
+    size_t ntiles = self->tiles->size();
+    for (Py_ssize_t i = 0; i < nflows; i++) {
+        tixs[i] = PyLong_AsLongLong(
+            til ? PyList_GET_ITEM(tile_ids, i)
+                : PyTuple_GET_ITEM(tile_ids, i));
+        laccs[i] = PyLong_AsLong(acl ? PyList_GET_ITEM(accs, i)
+                                     : PyTuple_GET_ITEM(accs, i));
+        if (!PyErr_Occurred() &&
+            (tixs[i] < 0 || (size_t)tixs[i] >= ntiles))
+            PyErr_SetString(PyExc_IndexError, "bad tile id");
+        if (PyErr_Occurred()) return nullptr;
+    }
+
+    int64_t tid;
+    int32_t held;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        tid = link_locked(self, tixs, laccs, nflows);
+        held = (*self->tasks)[(size_t)tid].deps_remaining;
+    }
+    return Py_BuildValue("(Li)", (long long)tid, (int)held);
 }
 
 // activate(task_id) -> deps_remaining after dropping the insertion guard
@@ -226,88 +343,609 @@ PyObject *engine_activate(PyObject *obj, PyObject *arg) {
     Engine *self = reinterpret_cast<Engine *>(obj);
     int64_t tid = PyLong_AsLongLong(arg);
     if (PyErr_Occurred()) return nullptr;
-    std::vector<TaskRec> &tasks = *self->tasks;
-    if (tid < 0 || (size_t)tid >= tasks.size()) {
-        PyErr_SetString(PyExc_IndexError, "bad task id");
-        return nullptr;
+    int32_t left;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        std::vector<TaskRec> &tasks = *self->tasks;
+        if (tid < 0 || (size_t)tid >= tasks.size()) {
+            PyErr_SetString(PyExc_IndexError, "bad task id");
+            return nullptr;
+        }
+        TaskRec &rec = tasks[(size_t)tid];
+        if (rec.completed || rec.cls >= 0) {
+            PyErr_SetString(PyExc_RuntimeError,
+                            rec.completed ? "activate after completion"
+                                          : "activate on a batch-lane task");
+            return nullptr;
+        }
+        left = --rec.deps_remaining;
     }
-    TaskRec &rec = tasks[(size_t)tid];
-    if (rec.completed) {
-        PyErr_SetString(PyExc_RuntimeError, "activate after completion");
-        return nullptr;
-    }
-    return PyLong_FromLong(--rec.deps_remaining);
+    return PyLong_FromLong(left);
 }
 
-// complete(task_id) -> tuple of newly-ready task ids (often empty)
+// complete(task_id) -> tuple of newly-ready PER-TASK-LANE task ids (often
+// empty). Newly-ready batch-lane successors are NOT surfaced: they join
+// the engine's internal ready structure for the next drain_ready().
 PyObject *engine_complete(PyObject *obj, PyObject *arg) {
     Engine *self = reinterpret_cast<Engine *>(obj);
     int64_t tid = PyLong_AsLongLong(arg);
     if (PyErr_Occurred()) return nullptr;
-    std::vector<TaskRec> &tasks = *self->tasks;
-    if (tid < 0 || (size_t)tid >= tasks.size()) {
-        PyErr_SetString(PyExc_IndexError, "bad task id");
-        return nullptr;
-    }
-    TaskRec &rec = tasks[(size_t)tid];
-    if (rec.completed) {
-        PyErr_SetString(PyExc_RuntimeError, "task completed twice");
-        return nullptr;
-    }
-    rec.completed = true;
-    self->live--;
-    // move out the successor list so the record sheds its heap storage
-    std::vector<int64_t> succs;
-    succs.swap(rec.succs);
-    int64_t ready[64];
-    size_t nready = 0;
-    PyObject *out = nullptr;
-    for (int64_t s : succs) {
-        TaskRec &sr = tasks[(size_t)s];
-        if (--sr.deps_remaining == 0) {
-            if (nready < 64) {
-                ready[nready++] = s;
-            } else {
-                // very wide release: spill into the tuple path
-                if (!out) {
-                    out = PyList_New(0);
-                    if (!out) return nullptr;
-                    for (size_t i = 0; i < nready; i++) {
-                        PyObject *v = PyLong_FromLongLong(ready[i]);
-                        if (!v || PyList_Append(out, v) < 0) {
-                            Py_XDECREF(v); Py_DECREF(out); return nullptr;
-                        }
-                        Py_DECREF(v);
-                    }
-                }
-                PyObject *v = PyLong_FromLongLong(s);
-                if (!v || PyList_Append(out, v) < 0) {
-                    Py_XDECREF(v); Py_DECREF(out); return nullptr;
-                }
-                Py_DECREF(v);
-            }
+    std::vector<int64_t> surfaced;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        std::vector<TaskRec> &tasks = *self->tasks;
+        if (tid < 0 || (size_t)tid >= tasks.size()) {
+            PyErr_SetString(PyExc_IndexError, "bad task id");
+            return nullptr;
         }
+        TaskRec &rec = tasks[(size_t)tid];
+        if (rec.completed) {
+            PyErr_SetString(PyExc_RuntimeError, "task completed twice");
+            return nullptr;
+        }
+        if (rec.cls >= 0) {
+            PyErr_SetString(PyExc_RuntimeError,
+                            "complete() on a batch-lane task");
+            return nullptr;
+        }
+        complete_locked(self, tid, surfaced);
     }
-    if (out) {
-        PyObject *tup = PyList_AsTuple(out);
-        Py_DECREF(out);
-        return tup;
-    }
-    PyObject *tup = PyTuple_New((Py_ssize_t)nready);
+    PyObject *tup = PyTuple_New((Py_ssize_t)surfaced.size());
     if (!tup) return nullptr;
-    for (size_t i = 0; i < nready; i++) {
-        PyObject *v = PyLong_FromLongLong(ready[i]);
+    for (size_t i = 0; i < surfaced.size(); i++) {
+        PyObject *v = PyLong_FromLongLong(surfaced[i]);
         if (!v) { Py_DECREF(tup); return nullptr; }
         PyTuple_SET_ITEM(tup, (Py_ssize_t)i, v);
     }
     return tup;
 }
 
+// ------------------------------------------------------------ batched lane
+
+// register_class(callback, argmap, accs[, retire]) -> class id
+//   callback(args_list) -> outs_list|None: runs the bodies for one batch.
+//     args_list[i] is the i-th task's body-args tuple (payloads gathered
+//     from the tile slots per argmap). For classes with WRITE flows the
+//     callback must return a list whose i-th entry is a tuple with one
+//     output per WRITE flow, in flow order (the Python side normalizes).
+//   argmap: per body arg, the flow index it reads, or -1 for the next
+//     entry of the task's by-value tuple.
+//   accs: per-flow access bits (WRITE flows receive landed outputs).
+//   retire(n): optional; called AFTER the batch's outputs have landed in
+//     the tile slots and its release walk has run (drain_ready phase 3),
+//     so execution-count consumers (wait()'s done predicate) can never
+//     observe the counters ahead of the payloads.
+PyObject *engine_register_class(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *cb, *argmap_o, *accs_o, *retire = Py_None;
+    if (!PyArg_ParseTuple(args, "OOO|O", &cb, &argmap_o, &accs_o, &retire))
+        return nullptr;
+    if (!PyCallable_Check(cb)) {
+        PyErr_SetString(PyExc_TypeError, "callback must be callable");
+        return nullptr;
+    }
+    if (retire != Py_None && !PyCallable_Check(retire)) {
+        PyErr_SetString(PyExc_TypeError, "retire must be callable or None");
+        return nullptr;
+    }
+    ClassRec cr;
+    PyObject *fast = PySequence_Fast(argmap_o, "argmap: sequence of ints");
+    if (!fast) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(fast, i));
+        if (v == -1 && PyErr_Occurred()) { Py_DECREF(fast); return nullptr; }
+        cr.argmap.push_back((int32_t)v);
+        if (v < 0) cr.nvals++;
+    }
+    Py_DECREF(fast);
+    fast = PySequence_Fast(accs_o, "accs: sequence of ints");
+    if (!fast) return nullptr;
+    n = PySequence_Fast_GET_SIZE(fast);
+    if (n > PT_FLOWS_MAX) {
+        Py_DECREF(fast);
+        PyErr_SetString(PyExc_ValueError, "too many flows (max 64)");
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(fast, i));
+        if (v == -1 && PyErr_Occurred()) { Py_DECREF(fast); return nullptr; }
+        cr.accs.push_back((int32_t)v);
+        if (v & ACC_WRITE) cr.nwrites++;
+    }
+    Py_DECREF(fast);
+    for (int32_t a : cr.argmap) {
+        if (a >= (int32_t)cr.accs.size()) {
+            PyErr_SetString(PyExc_ValueError, "argmap flow index out of range");
+            return nullptr;
+        }
+    }
+    Py_INCREF(cb);
+    cr.cb = cb;
+    if (retire != Py_None) {
+        Py_INCREF(retire);
+        cr.retire = retire;
+    }
+    Py_ssize_t cls;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        self->classes->push_back(cr);     // vector owns the cb reference now
+        cls = (Py_ssize_t)self->classes->size() - 1;
+    }
+    return PyLong_FromSsize_t(cls);
+}
+
+// insert_many(specs) -> count
+//   specs: list of per-task tuples (cls, vals_or_None, t0, a0, t1, a1, …).
+//   Parses and validates everything under the GIL, then links the whole
+//   batch with the GIL DROPPED (engine mutex held): concurrent inserter
+//   threads overlap their link walks with body execution. Each task keeps
+//   the count-then-activate protocol — the guard drops only after the
+//   task's class/flow/value record is fully stored, inside the same
+//   locked region, so a racing complete() can never surface a
+//   half-inserted task.
+PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *fast = PySequence_Fast(arg, "specs: sequence");
+    if (!fast) return nullptr;
+    Py_ssize_t ntask = PySequence_Fast_GET_SIZE(fast);
+    struct Spec { int32_t cls; int32_t nflows; int64_t foff; PyObject *vals; };
+    std::vector<Spec> specs;
+    specs.reserve((size_t)ntask);
+    std::vector<int64_t> ftile, facc;   // local flow staging
+    // tiles/classes sizes read under the GIL: ids only grow, and anything
+    // referenced here was created before this call
+    const size_t ntiles = self->tiles->size();
+    const std::vector<ClassRec> &classes = *self->classes;
+    bool bad = false;
+    for (Py_ssize_t i = 0; i < ntask && !bad; i++) {
+        PyObject *it = PySequence_Fast_GET_ITEM(fast, i);
+        if (!PyTuple_Check(it)) { bad = true; break; }
+        Py_ssize_t sz = PyTuple_GET_SIZE(it);
+        if (sz < 2 || ((sz - 2) & 1)) { bad = true; break; }
+        Py_ssize_t nf = (sz - 2) / 2;
+        if (nf > PT_FLOWS_MAX) { bad = true; break; }
+        long cls = PyLong_AsLong(PyTuple_GET_ITEM(it, 0));
+        if (PyErr_Occurred() || cls < 0 ||
+            (size_t)cls >= classes.size()) { bad = true; break; }
+        PyObject *vals = PyTuple_GET_ITEM(it, 1);
+        const ClassRec &cr = classes[(size_t)cls];
+        if (vals == Py_None) {
+            if (cr.nvals != 0) { bad = true; break; }
+            vals = nullptr;
+        } else {
+            if (!PyTuple_Check(vals) ||
+                PyTuple_GET_SIZE(vals) != cr.nvals) { bad = true; break; }
+        }
+        if ((Py_ssize_t)cr.accs.size() != nf) { bad = true; break; }
+        Spec sp{(int32_t)cls, (int32_t)nf, (int64_t)ftile.size(), vals};
+        for (Py_ssize_t k = 0; k < nf; k++) {
+            int64_t tix = PyLong_AsLongLong(PyTuple_GET_ITEM(it, 2 + 2 * k));
+            int64_t acc = PyLong_AsLong(PyTuple_GET_ITEM(it, 3 + 2 * k));
+            if (PyErr_Occurred() || tix < 0 || (size_t)tix >= ntiles) {
+                bad = true; break;
+            }
+            ftile.push_back(tix);
+            facc.push_back(acc);
+        }
+        if (!bad) specs.push_back(sp);
+    }
+    if (bad) {
+        Py_DECREF(fast);
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError, "malformed insert_many spec");
+        return nullptr;
+    }
+    for (auto &sp : specs) Py_XINCREF(sp.vals);   // own across the link
+    Py_DECREF(fast);   // specs' vals survive via the INCREF above
+
+    // the whole batch links under ONE GIL drop
+    PyThreadState *ts = PyEval_SaveThread();
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        std::vector<TaskRec> &tasks = *self->tasks;
+        const int64_t base = (int64_t)self->flow_tile->size();
+        self->flow_tile->insert(self->flow_tile->end(), ftile.begin(),
+                                ftile.end());
+        self->flow_acc->insert(self->flow_acc->end(), facc.begin(),
+                               facc.end());
+        for (auto &sp : specs) {
+            int64_t tid = link_locked(self, ftile.data() + sp.foff,
+                                      facc.data() + sp.foff, sp.nflows);
+            TaskRec &rec = tasks[(size_t)tid];
+            rec.cls = sp.cls;
+            rec.flow_off = base + sp.foff;
+            rec.flow_n = sp.nflows;
+            rec.vals = sp.vals;           // ownership moves to the record
+            // count-then-activate: the record is fully stored; drop the
+            // guard. 0 deps -> straight onto the internal ready structure
+            if (--rec.deps_remaining == 0)
+                self->ready->push_back(tid);
+        }
+    }
+    PyEval_RestoreThread(ts);
+    return PyLong_FromSsize_t(ntask);
+}
+
+// drain_ready(max_batch=256, budget=4096) -> (n_executed, surfaced)
+//
+// The in-lane ready-drain: pops ready batch-lane tasks, groups them by
+// class, gathers each task's body args from the tile payload slots,
+// invokes the class callback ONCE per (class, batch), lands written
+// payloads back into the slots, and feeds the release walk straight back
+// into the ready structure — intermediate ids never surface to Python.
+// Newly-ready per-task-lane successors are returned in `surfaced` for
+// the caller to schedule. Returns promptly when no batch-lane work is
+// ready. Called with the GIL held; the callback runs with the GIL held
+// and the engine mutex RELEASED (bodies may re-enter insert paths).
+PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    int max_batch = 256;
+    long long budget = 4096;
+    if (!PyArg_ParseTuple(args, "|iL", &max_batch, &budget))
+        return nullptr;
+    if (max_batch <= 0) max_batch = 256;
+    long long total = 0;
+    std::vector<int64_t> surfaced;
+    // (cls, tid) pairs: cls is snapshotted while the pops hold the mutex —
+    // a concurrent insert_many links with the GIL DROPPED (mutex held) and
+    // may reallocate the tasks vector, so the sort below must never
+    // dereference it unlocked
+    std::vector<std::pair<int32_t, int64_t>> local;
+    std::vector<PyObject *> argrefs, defer_decref;
+    std::vector<int32_t> accs_snap, argmap_snap;
+    for (;;) {
+        local.clear();
+        {
+            std::lock_guard<std::mutex> lk(*self->mu);
+            if (self->poisoned || self->ready->empty()) break;
+            size_t take = std::min((size_t)max_batch, self->ready->size());
+            for (size_t k = self->ready->size() - take;
+                 k < self->ready->size(); k++) {
+                int64_t tid = (*self->ready)[k];
+                local.emplace_back((*self->tasks)[(size_t)tid].cls, tid);
+            }
+            self->ready->resize(self->ready->size() - take);
+        }
+        // group by class so each callback sees one homogeneous batch; the
+        // snapshot pairs keep the comparator off the live tasks vector
+        std::stable_sort(local.begin(), local.end(),
+                         [](const std::pair<int32_t, int64_t> &a,
+                            const std::pair<int32_t, int64_t> &b) {
+                             return a.first < b.first;
+                         });
+        size_t gi = 0;
+        while (gi < local.size()) {
+            size_t gj = gi;
+            const int32_t cls = local[gi].first;
+            while (gj < local.size() && local[gj].first == cls)
+                gj++;
+            const size_t gn = gj - gi;
+            // snapshot the class record: the callback releases the GIL, so
+            // a concurrent register_class may reallocate the vector —
+            // references into it must not be held across the dispatch
+            // (reading it GIL-held needs no mutex: every classes mutator
+            // runs under the GIL and never drops it)
+            PyObject *cb, *retire;
+            int32_t nwrites;
+            {
+                const ClassRec &cr = (*self->classes)[(size_t)cls];
+                cb = cr.cb;
+                if (!cb) {
+                    // release_pool() already dropped this class: its pool
+                    // completed, so no task of it can be ready — seeing one
+                    // means the caller broke the hand-off contract
+                    PyErr_SetString(PyExc_RuntimeError,
+                                    "batch class released with tasks "
+                                    "still outstanding");
+                    std::lock_guard<std::mutex> lk(*self->mu);
+                    self->poisoned = true;
+                    return nullptr;
+                }
+                Py_INCREF(cb);
+                retire = cr.retire;
+                Py_XINCREF(retire);
+                nwrites = cr.nwrites;
+                accs_snap = cr.accs;
+                argmap_snap = cr.argmap;
+            }
+            const size_t nargs = argmap_snap.size();
+            // phase 1 (mutex held): snapshot payload/value references with
+            // bare INCREFs — no allocation, no arbitrary code under mu
+            argrefs.clear();
+            argrefs.reserve(gn * nargs);
+            {
+                std::lock_guard<std::mutex> lk(*self->mu);
+                for (size_t t = gi; t < gj; t++) {
+                    TaskRec &rec = (*self->tasks)[(size_t)local[t].second];
+                    int32_t vi = 0;
+                    for (size_t a = 0; a < nargs; a++) {
+                        PyObject *v;
+                        int32_t f = argmap_snap[a];
+                        if (f < 0) {
+                            v = rec.vals
+                                ? PyTuple_GET_ITEM(rec.vals, vi) : Py_None;
+                            vi++;
+                        } else {
+                            int64_t tix =
+                                (*self->flow_tile)[(size_t)(rec.flow_off + f)];
+                            v = (*self->tiles)[(size_t)tix].payload;
+                            if (!v) v = Py_None;
+                        }
+                        Py_INCREF(v);
+                        argrefs.push_back(v);
+                    }
+                }
+            }
+            // phase 2 (mutex released): build the args list and dispatch
+            PyObject *args_list = PyList_New((Py_ssize_t)gn);
+            PyObject *outs = nullptr;
+            size_t consumed = 0;       // argref rows moved into tuples
+            if (args_list) {
+                bool ok = true;
+                for (size_t t = 0; t < gn; t++) {
+                    PyObject *tp = PyTuple_New((Py_ssize_t)nargs);
+                    if (!tp) { ok = false; break; }
+                    for (size_t a = 0; a < nargs; a++)
+                        PyTuple_SET_ITEM(tp, (Py_ssize_t)a,
+                                         argrefs[t * nargs + a]);
+                    consumed = t + 1;
+                    PyList_SET_ITEM(args_list, (Py_ssize_t)t, tp);
+                }
+                if (ok)
+                    outs = PyObject_CallFunctionObjArgs(cb, args_list,
+                                                        nullptr);
+            }
+            // drop any refs a failed allocation left unconsumed
+            for (size_t r = consumed * nargs; r < argrefs.size(); r++)
+                Py_DECREF(argrefs[r]);
+            Py_DECREF(cb);
+            if (!outs) {
+                Py_XDECREF(retire);
+                // the callback raised (or allocation failed): poison the
+                // lane so peers stop draining and propagate the exception
+                Py_XDECREF(args_list);
+                std::lock_guard<std::mutex> lk(*self->mu);
+                self->poisoned = true;
+                return nullptr;
+            }
+            if (nwrites) {
+                bool shape_ok = PyList_Check(outs) &&
+                                PyList_GET_SIZE(outs) == (Py_ssize_t)gn;
+                for (Py_ssize_t t = 0; shape_ok && t < (Py_ssize_t)gn; t++) {
+                    PyObject *o = PyList_GET_ITEM(outs, t);
+                    shape_ok = PyTuple_Check(o) &&
+                               PyTuple_GET_SIZE(o) >= (Py_ssize_t)nwrites;
+                }
+                if (!shape_ok) {
+                    Py_XDECREF(retire);
+                    Py_DECREF(args_list);
+                    Py_DECREF(outs);
+                    PyErr_SetString(PyExc_TypeError,
+                                    "batch callback must return one output "
+                                    "tuple per task (one item per WRITE "
+                                    "flow)");
+                    std::lock_guard<std::mutex> lk(*self->mu);
+                    self->poisoned = true;
+                    return nullptr;
+                }
+            }
+            // phase 3 (mutex held): land written payloads into the tile
+            // slots and run the release walk; DECREFs are deferred
+            defer_decref.clear();
+            {
+                std::lock_guard<std::mutex> lk(*self->mu);
+                for (size_t t = gi; t < gj; t++) {
+                    TaskRec &rec = (*self->tasks)[(size_t)local[t].second];
+                    if (nwrites) {
+                        PyObject *out_t =
+                            PyList_GET_ITEM(outs, (Py_ssize_t)(t - gi));
+                        Py_ssize_t oi = 0;
+                        for (size_t f = 0; f < accs_snap.size(); f++) {
+                            if (!(accs_snap[f] & ACC_WRITE)) continue;
+                            PyObject *nv = PyTuple_GET_ITEM(out_t, oi++);
+                            int64_t tix = (*self->flow_tile)
+                                [(size_t)(rec.flow_off + (int64_t)f)];
+                            TileRec &tile = (*self->tiles)[(size_t)tix];
+                            Py_INCREF(nv);
+                            if (tile.payload)
+                                defer_decref.push_back(tile.payload);
+                            tile.payload = nv;
+                            tile.writes++;
+                        }
+                    }
+                    if (rec.vals) {
+                        defer_decref.push_back(rec.vals);
+                        rec.vals = nullptr;
+                    }
+                    complete_locked(self, local[t].second, surfaced);
+                }
+                self->batch_done += (int64_t)gn;
+            }
+            for (PyObject *p : defer_decref) Py_DECREF(p);
+            Py_DECREF(args_list);
+            Py_DECREF(outs);
+            // retire AFTER phase 3: the pool's execution counters must
+            // trail the payload landing, or a waiter observing
+            // "executed == target" could sync stale slots
+            if (retire) {
+                PyObject *rr =
+                    PyObject_CallFunction(retire, "n", (Py_ssize_t)gn);
+                Py_DECREF(retire);
+                if (!rr) {
+                    std::lock_guard<std::mutex> lk(*self->mu);
+                    self->poisoned = true;
+                    return nullptr;
+                }
+                Py_DECREF(rr);
+            }
+            total += (long long)gn;
+            gi = gj;
+        }
+        if (budget > 0 && total >= budget) break;
+    }
+    PyObject *sur = PyTuple_New((Py_ssize_t)surfaced.size());
+    if (!sur) return nullptr;
+    for (size_t i = 0; i < surfaced.size(); i++) {
+        PyObject *v = PyLong_FromLongLong(surfaced[i]);
+        if (!v) { Py_DECREF(sur); return nullptr; }
+        PyTuple_SET_ITEM(sur, (Py_ssize_t)i, v);
+    }
+    PyObject *res = Py_BuildValue("(LN)", total, sur);
+    if (!res) Py_DECREF(sur);
+    return res;
+}
+
+// ------------------------------------------------------ tile payload slots
+
+// slot_set(tile_id, payload) — seed/refresh a tile's payload slot (does
+// NOT count as a batch-lane write: the per-task lane bumps its own
+// versions Python-side and mirrors the value here for batch readers)
+PyObject *engine_slot_set(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *payload;
+    long long nid;
+    if (!PyArg_ParseTuple(args, "LO", &nid, &payload))
+        return nullptr;
+    PyObject *old;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (nid < 0 || (size_t)nid >= self->tiles->size()) {
+            PyErr_SetString(PyExc_IndexError, "bad tile id");
+            return nullptr;
+        }
+        TileRec &tile = (*self->tiles)[(size_t)nid];
+        Py_INCREF(payload);
+        old = tile.payload;
+        tile.payload = payload;
+    }
+    Py_XDECREF(old);
+    Py_RETURN_NONE;
+}
+
+// slot_get(tile_id) -> payload or None (no bookkeeping side effects)
+PyObject *engine_slot_get(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    long long nid = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return nullptr;
+    PyObject *p;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (nid < 0 || (size_t)nid >= self->tiles->size()) {
+            PyErr_SetString(PyExc_IndexError, "bad tile id");
+            return nullptr;
+        }
+        p = (*self->tiles)[(size_t)nid].payload;
+        if (!p) p = Py_None;
+        Py_INCREF(p);
+    }
+    return p;
+}
+
+// slot_sync(tile_id) -> (payload_or_None, writes_since_last_sync)
+// Resets the write counter AND empties the slot (payload ownership moves
+// to the returned tuple): after a sync the tile's HOST copy is
+// authoritative again, so user updates to tile.data between quiescence
+// points are honored — the flush path re-seeds empty slots from
+// tile.data before the next batch links (dtd.py _flush_batch_locked).
+// A retained slot here would silently outrank a post-wait() reseed.
+PyObject *engine_slot_sync(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    long long nid = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return nullptr;
+    PyObject *p;
+    long long w;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (nid < 0 || (size_t)nid >= self->tiles->size()) {
+            PyErr_SetString(PyExc_IndexError, "bad tile id");
+            return nullptr;
+        }
+        TileRec &tile = (*self->tiles)[(size_t)nid];
+        p = tile.payload;            // ownership moves to the result
+        tile.payload = nullptr;
+        if (!p) { p = Py_None; Py_INCREF(p); }
+        w = tile.writes;
+        tile.writes = 0;
+    }
+    PyObject *res = Py_BuildValue("(NL)", p, w);
+    if (!res) Py_DECREF(p);
+    return res;
+}
+
+// release_pool(tile_ids, class_ids) — drop the engine-side references a
+// completed pool pinned: tile payload slots and class callbacks. The
+// Engine is per-CONTEXT while pools come and go, so without this every
+// dead pool's payloads (and, through the callback closures, the pool
+// object itself) would live until context teardown. Only legal once the
+// pool is fully drained: a released class's tasks must never be ready.
+PyObject *engine_release_pool(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *tiles_o, *classes_o;
+    if (!PyArg_ParseTuple(args, "OO", &tiles_o, &classes_o))
+        return nullptr;
+    // parse ids BEFORE taking the mutex (no Python calls under mu)
+    std::vector<int64_t> tids, cids;
+    for (int pass = 0; pass < 2; pass++) {
+        PyObject *src = pass ? classes_o : tiles_o;
+        std::vector<int64_t> &dst = pass ? cids : tids;
+        PyObject *fast = PySequence_Fast(src, "release_pool: sequence of ids");
+        if (!fast) return nullptr;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            int64_t v =
+                PyLong_AsLongLong(PySequence_Fast_GET_ITEM(fast, i));
+            if (v == -1 && PyErr_Occurred()) { Py_DECREF(fast); return nullptr; }
+            dst.push_back(v);
+        }
+        Py_DECREF(fast);
+    }
+    std::vector<PyObject *> defer_decref;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        for (int64_t nid : tids) {
+            if (nid < 0 || (size_t)nid >= self->tiles->size()) {
+                PyErr_SetString(PyExc_IndexError, "bad tile id");
+                goto fail;
+            }
+            TileRec &tile = (*self->tiles)[(size_t)nid];
+            if (tile.payload) {
+                defer_decref.push_back(tile.payload);
+                tile.payload = nullptr;
+            }
+            tile.writes = 0;
+        }
+        for (int64_t cid : cids) {
+            if (cid < 0 || (size_t)cid >= self->classes->size()) {
+                PyErr_SetString(PyExc_IndexError, "bad class id");
+                goto fail;
+            }
+            ClassRec &cr = (*self->classes)[(size_t)cid];
+            if (cr.cb) {
+                defer_decref.push_back(cr.cb);
+                cr.cb = nullptr;
+            }
+            if (cr.retire) {
+                defer_decref.push_back(cr.retire);
+                cr.retire = nullptr;
+            }
+        }
+    }
+    for (PyObject *p : defer_decref) Py_DECREF(p);
+    Py_RETURN_NONE;
+fail:
+    for (PyObject *p : defer_decref) Py_DECREF(p);
+    return nullptr;
+}
+
+// ------------------------------------------------------------- diagnostics
+
 // deps_remaining(task_id) -> int  (diagnostics / paranoid checks)
 PyObject *engine_deps_remaining(PyObject *obj, PyObject *arg) {
     Engine *self = reinterpret_cast<Engine *>(obj);
     int64_t tid = PyLong_AsLongLong(arg);
     if (PyErr_Occurred()) return nullptr;
+    std::lock_guard<std::mutex> lk(*self->mu);
     if (tid < 0 || (size_t)tid >= self->tasks->size()) {
         PyErr_SetString(PyExc_IndexError, "bad task id");
         return nullptr;
@@ -317,11 +955,25 @@ PyObject *engine_deps_remaining(PyObject *obj, PyObject *arg) {
 
 PyObject *engine_pending(PyObject *obj, PyObject *) {
     Engine *self = reinterpret_cast<Engine *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
     return PyLong_FromLongLong(self->live);
+}
+
+PyObject *engine_ready_count(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    return PyLong_FromSsize_t((Py_ssize_t)self->ready->size());
+}
+
+PyObject *engine_batch_executed(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    return PyLong_FromLongLong(self->batch_done);
 }
 
 PyObject *engine_sizes(PyObject *obj, PyObject *) {
     Engine *self = reinterpret_cast<Engine *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
     return Py_BuildValue("(nn)", (Py_ssize_t)self->tasks->size(),
                          (Py_ssize_t)self->tiles->size());
 }
@@ -335,13 +987,223 @@ PyMethodDef engine_methods[] = {
     {"activate", engine_activate, METH_O,
      "drop the insertion guard; returns deps remaining (0 = ready now)"},
     {"complete", engine_complete, METH_O,
-     "complete(task_id) -> tuple of newly-ready task ids"},
+     "complete(task_id) -> tuple of newly-ready per-task-lane ids"},
+    {"register_class", engine_register_class, METH_VARARGS,
+     "register_class(callback, argmap, accs[, retire]) -> batch-lane "
+     "class id; retire(n) fires after each batch's outputs land"},
+    {"insert_many", engine_insert_many, METH_O,
+     "insert_many(specs) -> count; links the whole batch under one GIL "
+     "drop (count-then-activate per task)"},
+    {"drain_ready", engine_drain_ready, METH_VARARGS,
+     "drain_ready(max_batch=256, budget=4096) -> (n_executed, surfaced); "
+     "runs ready batch-lane tasks via per-class batched callbacks"},
+    {"slot_set", engine_slot_set, METH_VARARGS,
+     "slot_set(tile_id, payload): seed/refresh a tile's payload slot"},
+    {"slot_get", engine_slot_get, METH_O,
+     "slot_get(tile_id) -> payload or None"},
+    {"slot_sync", engine_slot_sync, METH_O,
+     "slot_sync(tile_id) -> (payload, writes-since-last-sync); resets the "
+     "write counter"},
+    {"release_pool", engine_release_pool, METH_VARARGS,
+     "release_pool(tile_ids, class_ids): drop a completed pool's slot "
+     "payloads and class callbacks"},
     {"deps_remaining", engine_deps_remaining, METH_O,
      "deps_remaining(task_id) -> int"},
     {"pending", engine_pending, METH_NOARGS,
      "live (incomplete) task count"},
+    {"ready_count", engine_ready_count, METH_NOARGS,
+     "ready batch-lane tasks awaiting drain"},
+    {"batch_executed", engine_batch_executed, METH_NOARGS,
+     "total batch-lane tasks executed by drain_ready"},
     {"sizes", engine_sizes, METH_NOARGS,
      "(total tasks ever, total tiles) — memory diagnostics"},
+    {nullptr, nullptr, 0, nullptr}};
+
+// ----------------------------------------------------- insert fast path
+
+// Interned attribute names + the small-int singletons the fast path
+// compares against, created once at module init: the per-call
+// GetAttrString/PyLong_AsLong round-trips were ~40% of try_buffer's cost
+// at the measured ~600ns/call.
+PyObject *s_nid = nullptr;      // "nid"
+PyObject *s_zero = nullptr;     // int 0   (default priority)
+PyObject *s_devall = nullptr;   // int 255 (DEV_ALL)
+
+// try_buffer(fstate, fn, args, priority, where, jit, batch) -> int
+//
+// The MODULE-LEVEL insert_task fast path: validates one insert call
+// against the pool's one-entry fast cache and appends its batch spec to
+// the insert buffer — the ~30 interpreter bytecodes the Python fast path
+// would spend per insert collapse into one C call (METH_FASTCALL: no
+// argument tuple is ever materialized). Touches NO engine state (the
+// buffer is a plain Python list; append is GIL-atomic), so it is a free
+// function, not a method.
+//
+//   fstate: (fn, jit, batch, kinds, cls, buf, flush_n, tile_type)
+//       kinds: bare acc int for the single-flow shape, else a tuple with
+//       one entry per arg — the acc int for flow positions, None for
+//       by-value positions. tile_type: the DTDTile class (exact match).
+//   returns 0 = take the slow path, 1 = buffered,
+//           2 = buffered and the flush threshold was reached
+PyObject *ptdtd_try_buffer(PyObject *, PyObject *const *fc,
+                           Py_ssize_t nfc) {
+    if (nfc != 7) {
+        PyErr_SetString(PyExc_TypeError, "try_buffer takes 7 arguments");
+        return nullptr;
+    }
+    PyObject *fstate = fc[0], *fn = fc[1], *args = fc[2], *priority = fc[3],
+             *where = fc[4], *jit = fc[5], *batch = fc[6];
+    if (!PyTuple_Check(fstate) || PyTuple_GET_SIZE(fstate) != 8 ||
+        !PyTuple_Check(args))
+        return PyLong_FromLong(0);
+    // gate: same fn object, same jit/batch flags (canonical bools compare
+    // by identity), priority 0, no device restriction. Small ints are
+    // singletons in CPython, so the common literals hit the pointer
+    // compare; anything else takes the boxed-value check once.
+    if (PyTuple_GET_ITEM(fstate, 0) != fn ||
+        PyTuple_GET_ITEM(fstate, 1) != jit ||
+        PyTuple_GET_ITEM(fstate, 2) != batch)
+        return PyLong_FromLong(0);
+    if (priority != s_zero &&
+        (!PyLong_CheckExact(priority) || PyLong_AsLong(priority) != 0)) {
+        if (PyErr_Occurred()) PyErr_Clear();
+        return PyLong_FromLong(0);
+    }
+    if (where != s_devall &&
+        (!PyLong_CheckExact(where) || PyLong_AsLong(where) != 0xFF)) {
+        if (PyErr_Occurred()) PyErr_Clear();
+        return PyLong_FromLong(0);
+    }
+    PyObject *kinds = PyTuple_GET_ITEM(fstate, 3);
+    PyObject *cls = PyTuple_GET_ITEM(fstate, 4);
+    PyObject *buf = PyTuple_GET_ITEM(fstate, 5);
+    PyObject *flushn_o = PyTuple_GET_ITEM(fstate, 6);
+    PyObject *tile_type = PyTuple_GET_ITEM(fstate, 7);
+    if (!PyList_Check(buf)) return PyLong_FromLong(0);
+    PyObject *spec = nullptr;
+    if (PyLong_CheckExact(kinds)) {
+        // single-flow shape: args == ((tile, acc),) with acc == kinds
+        if (PyTuple_GET_SIZE(args) != 1) return PyLong_FromLong(0);
+        PyObject *a = PyTuple_GET_ITEM(args, 0);
+        if (!PyTuple_CheckExact(a) || PyTuple_GET_SIZE(a) != 2)
+            return PyLong_FromLong(0);
+        PyObject *acc = PyTuple_GET_ITEM(a, 1);
+        int eq = PyObject_RichCompareBool(acc, kinds, Py_EQ);
+        if (eq < 0) { PyErr_Clear(); return PyLong_FromLong(0); }
+        if (!eq) return PyLong_FromLong(0);
+        PyObject *tile = PyTuple_GET_ITEM(a, 0);
+        if ((PyObject *)Py_TYPE(tile) != tile_type)
+            return PyLong_FromLong(0);
+        PyObject *nid = PyObject_GetAttr(tile, s_nid);
+        if (!nid) { PyErr_Clear(); return PyLong_FromLong(0); }
+        if (nid == Py_None) {    // first native touch: slow path seeds it
+            Py_DECREF(nid);
+            return PyLong_FromLong(0);
+        }
+        spec = PyTuple_New(4);
+        if (!spec) { Py_DECREF(nid); return nullptr; }
+        Py_INCREF(cls);
+        Py_INCREF(Py_None);
+        Py_INCREF(kinds);
+        PyTuple_SET_ITEM(spec, 0, cls);
+        PyTuple_SET_ITEM(spec, 1, Py_None);
+        PyTuple_SET_ITEM(spec, 2, nid);
+        PyTuple_SET_ITEM(spec, 3, kinds);
+    } else {
+        // general shape: walk the kinds pattern
+        if (!PyTuple_CheckExact(kinds) ||
+            PyTuple_GET_SIZE(args) != PyTuple_GET_SIZE(kinds))
+            return PyLong_FromLong(0);
+        Py_ssize_t na = PyTuple_GET_SIZE(kinds);
+        PyObject *vals = nullptr;   // lazily built list of by-value args
+        std::vector<PyObject *> flows;   // borrowed (nid, acc) pairs...
+        std::vector<PyObject *> owned;   // nid refs to release on bail
+        bool ok = true;
+        for (Py_ssize_t i = 0; i < na && ok; i++) {
+            PyObject *k = PyTuple_GET_ITEM(kinds, i);
+            PyObject *a = PyTuple_GET_ITEM(args, i);
+            if (k == Py_None) {
+                // by-value position: a flow-shaped arg changes the spec
+                if ((PyObject *)Py_TYPE(a) == tile_type) { ok = false; break; }
+                if (PyTuple_CheckExact(a) && PyTuple_GET_SIZE(a) == 2 &&
+                    (PyObject *)Py_TYPE(PyTuple_GET_ITEM(a, 0)) ==
+                        tile_type) { ok = false; break; }
+                if (!vals) {
+                    vals = PyList_New(0);
+                    if (!vals) { ok = false; break; }
+                }
+                if (PyList_Append(vals, a) < 0) { ok = false; break; }
+            } else {
+                if (!PyTuple_CheckExact(a) || PyTuple_GET_SIZE(a) != 2) {
+                    ok = false; break;
+                }
+                int eq = PyObject_RichCompareBool(PyTuple_GET_ITEM(a, 1),
+                                                  k, Py_EQ);
+                if (eq <= 0) { ok = false; break; }
+                PyObject *tile = PyTuple_GET_ITEM(a, 0);
+                if ((PyObject *)Py_TYPE(tile) != tile_type) {
+                    ok = false; break;
+                }
+                PyObject *nid = PyObject_GetAttr(tile, s_nid);
+                if (!nid || nid == Py_None) {
+                    if (!nid) PyErr_Clear();
+                    Py_XDECREF(nid); ok = false; break;
+                }
+                owned.push_back(nid);
+                flows.push_back(nid);
+                flows.push_back(k);
+            }
+        }
+        if (!ok) {
+            if (PyErr_Occurred()) PyErr_Clear();
+            for (PyObject *o : owned) Py_DECREF(o);
+            Py_XDECREF(vals);
+            return PyLong_FromLong(0);
+        }
+        spec = PyTuple_New(2 + (Py_ssize_t)flows.size());
+        if (!spec) {
+            for (PyObject *o : owned) Py_DECREF(o);
+            Py_XDECREF(vals);
+            return nullptr;
+        }
+        Py_INCREF(cls);
+        PyTuple_SET_ITEM(spec, 0, cls);
+        if (vals) {
+            PyObject *vt = PyList_AsTuple(vals);
+            Py_DECREF(vals);
+            if (!vt) {
+                for (PyObject *o : owned) Py_DECREF(o);
+                Py_DECREF(spec);
+                return nullptr;
+            }
+            PyTuple_SET_ITEM(spec, 1, vt);
+        } else {
+            Py_INCREF(Py_None);
+            PyTuple_SET_ITEM(spec, 1, Py_None);
+        }
+        for (size_t i = 0; i < flows.size(); i += 2) {
+            PyTuple_SET_ITEM(spec, 2 + (Py_ssize_t)i, flows[i]); // owned nid
+            Py_INCREF(flows[i + 1]);
+            PyTuple_SET_ITEM(spec, 3 + (Py_ssize_t)i, flows[i + 1]);
+        }
+    }
+    int rc = PyList_Append(buf, spec);
+    Py_DECREF(spec);
+    if (rc < 0) return nullptr;
+    long flushn = PyLong_AsLong(flushn_o);
+    if (flushn > 0 && PyList_GET_SIZE(buf) >= flushn)
+        return PyLong_FromLong(2);
+    return PyLong_FromLong(1);
+}
+
+PyMethodDef ptdtd_functions[] = {
+    {"try_buffer",
+     reinterpret_cast<PyCFunction>(
+         reinterpret_cast<void (*)(void)>(ptdtd_try_buffer)),
+     METH_FASTCALL,
+     "insert_task fast path: validate one call against the pool's fast "
+     "cache and append its batch spec (0=slow path, 1=buffered, "
+     "2=buffered+flush)"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyTypeObject EngineType = [] {
@@ -359,12 +1221,16 @@ PyTypeObject EngineType = [] {
 PyModuleDef ptdtd_module = {
     PyModuleDef_HEAD_INIT, "_ptdtd",
     "native DTD dependency engine (see native/src/ptdtd.cpp)", -1,
-    nullptr, nullptr, nullptr, nullptr, nullptr};
+    ptdtd_functions, nullptr, nullptr, nullptr, nullptr};
 
 }  // namespace
 
 PyMODINIT_FUNC PyInit__ptdtd(void) {
     if (PyType_Ready(&EngineType) < 0) return nullptr;
+    s_nid = PyUnicode_InternFromString("nid");
+    s_zero = PyLong_FromLong(0);
+    s_devall = PyLong_FromLong(0xFF);
+    if (!s_nid || !s_zero || !s_devall) return nullptr;
     PyObject *m = PyModule_Create(&ptdtd_module);
     if (!m) return nullptr;
     Py_INCREF(&EngineType);

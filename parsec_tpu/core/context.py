@@ -141,6 +141,12 @@ class Context:
             ExecutionStream(i, self, vp_id=self.vpmap.thread_to_vp(i))
             for i in range(self.nb_cores)
         ]
+        #: True when the user picked a scheduler policy explicitly (ctor
+        #: arg or --mca sched): execution-order policy then matters to
+        #: them, and order-bypassing fast lanes (the DTD batched drain,
+        #: which backfills outside the scheduler queues) must not engage
+        self.sched_explicit = scheduler is not None or \
+            mca.get("sched", "lfq") != "lfq"
         self.sched = sched_mod.create(scheduler)
         self.sched.install(self)
         for s in self.streams:
@@ -188,6 +194,16 @@ class Context:
         #: walk is GIL-free, so in-process workers scale on real cores)
         self._ptexec_q: List = []
         self._ptexec_lock = threading.Lock()
+        #: the per-context native DTD engine (set by DTDTaskpool) and the
+        #: count of LIVE batched-lane pools: while any pool has the
+        #: batched insert lane armed, every stream's hot loop drains the
+        #: engine's internal ready structure (drain_ready) the way it
+        #: drains ptexec graphs. A count, not a sticky flag: each pool's
+        #: final completion decrements it, so later non-batch pools (e.g.
+        #: the bench's per-task-engine baseline reps) don't pay an empty
+        #: engine drain every idle iteration
+        self._dtd_neng = None
+        self._dtd_batch_pools = 0
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
 
@@ -460,6 +476,39 @@ class Context:
             return True
         return mine > 0
 
+    def _dtd_drain(self, stream: ExecutionStream) -> bool:
+        """One burst through the DTD engine's batched ready-drain (the
+        in-lane execute of the batched insert lane, ISSUE 4): pops ready
+        batch-lane tasks, runs their bodies through per-class batched
+        callbacks, and feeds completions straight back into the release
+        walk without surfacing intermediate ids. Only newly-ready
+        PER-TASK-lane successors come back (`surfaced`) and enter the
+        ordinary scheduler. Body exceptions poison the engine lane and
+        propagate through the usual error machinery."""
+        eng = self._dtd_neng
+        if eng is None:
+            return False
+        try:
+            nexec, surfaced = eng.drain_ready(256, 4096)
+        except BaseException as e:  # noqa: BLE001 — a batched body raised
+            if self._error is None:
+                self._error = e
+            self._work_event.set()
+            if stream.is_master:
+                raise
+            return True
+        if nexec:
+            stream.nb_executed += nexec
+        if surfaced:
+            ntasks = self._dtd_ntasks
+            rtasks = []
+            for rid in surfaced:
+                t = ntasks[rid]
+                t.deps_remaining = 0    # paranoid-check coherence
+                rtasks.append(t)
+            self.schedule(rtasks, stream)
+        return nexec > 0 or bool(surfaced)
+
     def _ptexec_abandon(self, lane: Dict[str, Any]) -> None:
         """Drop an errored data-mode lane's slot payloads. Each stream
         that exits the poisoned graph attempts this; the LAST one out
@@ -577,6 +626,16 @@ class Context:
                 else:
                     task, distance = self.sched.select(stream)
                 stream.nb_selects += 1
+            if task is None and self._dtd_batch_pools:
+                # native DTD batched lane: drain the engine's internal
+                # ready structure through per-class batched callbacks.
+                # AFTER the scheduler select on purpose: batched tasks all
+                # carry priority 0 (prioritized inserts ride the per-task
+                # lane), so scheduler-queued work — which includes every
+                # prioritized task — must preempt the batch backfill, the
+                # same policy order the interpreted FSM's priority-sorted
+                # queues give
+                did_something |= self._dtd_drain(stream)
             if task is not None:
                 misses = 0
                 # drain a burst before re-checking the loop conditions: the

@@ -156,11 +156,14 @@ class Data:
                     copy.coherency_state = COHERENCY_SHARED
             return copy
 
-    def bump_version(self, device_index: int) -> int:
+    def bump_version(self, device_index: int, n: int = 1) -> int:
         """Writer completed: new authoritative version on that device
-        (ref: version bump in parsec_device_kernel_epilog, device_gpu.c:3180)."""
+        (ref: version bump in parsec_device_kernel_epilog, device_gpu.c:3180).
+        ``n`` folds a batch of writes in one call (the DTD batched lane
+        lands N writes per tile natively and syncs the version delta at
+        quiescence, keeping version parity with per-write bumping)."""
         with self._lock:
-            self.version += 1
+            self.version += n
             copy = self.copies.get(device_index)
             if copy is not None:
                 copy.version = self.version

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -67,11 +68,46 @@ mca.register("dtd_audit", False,
              "analogue of the PTG iterators_checker)", type=bool)
 mca.register("dtd_threshold_size", 1024,
              "Catch-up target once the window is hit", type=int)
+mca.register("dtd_batch_insert", True,
+             "Batched native insert lane: buffer eligible insert_task calls "
+             "and link them in the engine N at a time under one GIL drop; "
+             "ready tasks execute through in-engine batched drains "
+             "(drain_ready) instead of per-task scheduler cycles", type=bool)
+
+#: engagement counters for the batched DTD lane (the DTD analogue of
+#: dsl/ptg/compiler.py PTEXEC_STATS — the ci.sh gate watches ENGAGEMENT,
+#: not throughput). ``tasks_batched`` counts inserts that rode the batch
+#: buffer; ``tasks_per_task`` counts inserts on batch-enabled pools that
+#: fell back to the per-task engine path (first insert of a class, shape
+#: mismatch, priority/where/NOTRACK/AFFINITY, jittable bodies with
+#: by-value args); ``pools_batch`` counts pools that enabled the lane.
+PTDTD_STATS = {"pools_batch": 0, "tasks_batched": 0, "tasks_per_task": 0,
+               "batches": 0, "classes_ineligible": 0}
+
+#: "batch registration not yet attempted" marker for the one-entry class
+#: cache (None means attempted-and-ineligible, which must not retry)
+_BINFO_UNSET = object()
 
 
 def _flush_body(arr):
     """data_flush task body: force device->host materialization."""
     return np.asarray(arr)
+
+
+#: serializes Context._dtd_batch_pools updates (pools arming/retiring from
+#: different threads; a torn read-modify-write would wedge the count and
+#: either stall the drains or run them forever)
+_BATCH_POOLS_LOCK = threading.Lock()
+
+
+def _pool_sync_on_complete(tp: "DTDTaskpool") -> None:
+    """Taskpool.on_complete hook for batch-lane pools: sync the engine's
+    tile payload slots into tile.data even when the user never calls
+    tp.wait() (close + ctx.wait drains through termination detection),
+    then hand the pool's engine-side state back (termdet fires this
+    exactly once, after close() — no further inserts can arrive)."""
+    tp._sync_slots()
+    tp._retire_batch_lane()
 
 
 class DTDTile:
@@ -294,6 +330,28 @@ class DTDTaskpool(Taskpool):
         #: engine, which owns the distributed protocol bookkeeping)
         self._neng = None
         self._neng_decided = False
+        #: batched native insert lane (ISSUE 4): eligible repeat inserts of
+        #: one class buffer their specs here (plain list: append is
+        #: GIL-atomic, so the fast path takes NO lock; flushers serialize
+        #: on the insert lock and drain a snapshot prefix with del-slice,
+        #: which can never race a concurrent tail append) and link in the
+        #: engine N at a time under one GIL drop (engine.insert_many).
+        #: Batched tasks have NO Python task object: the engine owns the
+        #: whole insert->link->ready->execute->release cycle; bodies run
+        #: through per-class batched callbacks at the drain points
+        #: (Context._dtd_drain in every stream's hot loop)
+        self._batch_on = False
+        self._batch_retired = False   # final-completion hand-back ran
+        self._slots_stale = False     # quiescence sync emptied the slots
+        self._bbuf: List[tuple] = []
+        self._batch_flush_n = max(1, min(256, self.window_size // 2))
+        #: one-entry FAST-PATH cache: (fn, jit, batch, kinds|k0, cls_nid,
+        #: bbuf, flush_n, DTDTile) — everything the native try_buffer
+        #: fast path needs in one tuple. kinds collapses to the bare acc
+        #: int for the dominant single-flow shape. Rebound wherever
+        #: _last_class gains a batch registration; cleared on close()
+        self._fast: Optional[tuple] = None
+        self._tbuf = None        # native try_buffer (set with _batch_on)
         #: ready-at-insert batch (native lane only): single-stream contexts
         #: gain nothing from per-task scheduler pushes, so ready tasks
         #: buffer here and enter the scheduler in BULK at the drain points
@@ -416,8 +474,298 @@ class DTDTaskpool(Taskpool):
             # drives the context directly (no tp.wait()); weakly bound so
             # a dropped pool unregisters itself
             ctx.register_drain_hook(self._flush_ready)
+            # batched insert lane: engine v2 (insert_many/drain_ready)
+            # on a CPU-only context with the DEFAULT scheduler. TPU
+            # contexts stay per-task — device selection / async epilogs
+            # are policy the in-engine drain bypasses, and a TPU epilog
+            # writing a tile behind the engine's payload slot would break
+            # slot coherence. An explicitly-chosen scheduler module also
+            # refuses the lane: batched tasks never enter the scheduler
+            # queues, so a user-selected ordering policy (FIFO, priority
+            # heap, ...) could not see them
+            if mca.get("dtd_batch_insert", True) \
+                    and hasattr(eng, "insert_many") \
+                    and not getattr(ctx, "sched_explicit", False) \
+                    and not any(d.type & DEV_TPU
+                                for d in ctx.devices.devices):
+                self._batch_on = True
+                from .. import native as _nm     # memoized load
+                self._tbuf = _nm.load_ptdtd().try_buffer
+                # open-batch-pool count gates the stream hot loops' engine
+                # drain; decremented at final completion so pools running
+                # AFTER this one (e.g. with the batch lane mca-disabled)
+                # don't pay an empty drain_ready every idle iteration
+                with _BATCH_POOLS_LOCK:
+                    ctx._dtd_batch_pools += 1
+                PTDTD_STATS["pools_batch"] += 1
+                # tile payload slots sync back into tile.data when the
+                # pool completes, even when the user never calls wait().
+                # CHAIN any prior hook — compound stages and recursive
+                # device pools set on_complete BEFORE their first insert,
+                # and must see the synced tile.data values when they fire
+                prev = self.on_complete
+                if prev is None:
+                    self.on_complete = _pool_sync_on_complete
+                else:
+                    def _chained(tp, _prev=prev):
+                        _pool_sync_on_complete(tp)
+                        _prev(tp)
+                    self.on_complete = _chained
         self._neng = eng
         return eng
+
+    # ------------------------------------------------------- batched lane
+    def _tile_nid(self, tile: DTDTile) -> int:
+        """The tile's engine chain id, created (and its payload slot
+        seeded) on first native touch. The check-then-create runs under
+        the insert lock: two threads racing here must not mint two engine
+        chains for one shared tile (the PR 2 concurrent-inserter bug)."""
+        nid = tile.nid
+        if nid is None:
+            with self._insert_lock:
+                nid = tile.nid
+                if nid is None:
+                    neng = self._neng
+                    nid = neng.tile()
+                    if self._batch_on:
+                        copy = tile.data.newest_copy()
+                        if copy is not None:
+                            neng.slot_set(nid, copy.payload)
+                    tile.nid = nid
+        return nid
+
+    def _slot_payload(self, tile: DTDTile):
+        """Newest payload of a tile on a batch-lane pool: the engine slot
+        is authoritative while batched writers are in flight (tile.data
+        syncs at wait/complete); falls back to newest_copy."""
+        if self._batch_on and tile.nid is not None:
+            p = self._neng.slot_get(tile.nid)
+            if p is not None:
+                return p
+        copy = tile.data.newest_copy()
+        return None if copy is None else copy.payload
+
+    def _mk_batch_callback(self, tc: "DTDTaskClass", argmap: Tuple[int, ...]):
+        """The per-class batched dispatch the engine's drain_ready invokes
+        once per (class, batch): run every body on its gathered args and
+        hand WRITE-flow outputs back for native slot landing. Execution
+        accounting does NOT happen here — the engine invokes
+        ``_batch_retire`` only after phase 3 has landed the outputs, so a
+        wait()er can never observe the counters ahead of the payloads."""
+        fn = tc.fn
+        use_jit = tc.jit_ok
+        wflows = [i for i, a in enumerate(tc.flow_accesses) if a & WRITE]
+        nw = len(wflows)
+        # arg position each write flow's input payload sits at (a body
+        # returning fewer outputs keeps the old payload, like _run_lean)
+        wpos = [argmap.index(i) for i in wflows]
+
+        def _batch_cb(args_list):
+            f = _jitted(fn) if use_jit else fn
+            if nw:
+                outs_list = []
+                ap = outs_list.append
+                for vals in args_list:
+                    o = f(*vals)
+                    if o is None:
+                        o = ()
+                    elif type(o) is not tuple:
+                        o = tuple(o) if isinstance(o, list) else (o,)
+                    if len(o) < nw:
+                        o = tuple(o[k] if k < len(o) else vals[wpos[k]]
+                                  for k in range(nw))
+                    ap(o)
+            else:
+                for vals in args_list:
+                    f(*vals)
+                outs_list = None
+            return outs_list
+
+        return _batch_cb
+
+    def _batch_retire(self, ne: int) -> None:
+        """Engine-invoked AFTER a batch's outputs have landed in the tile
+        slots and its release walk has run (drain_ready phase 3): retire
+        the batch's execution accounting in bulk (one _exec_lock acquire
+        and one nb_tasks update per BATCH instead of per task). Ordering
+        matters: retiring inside the batch callback — before the landing —
+        would let a concurrent wait() see ``executed >= target`` and
+        _sync_slots() the PRE-batch payloads, silently dropping the final
+        batch's writes."""
+        with self._exec_lock:
+            self._executed += ne
+        self.addto_nb_tasks(-ne)
+
+    def _mk_batch_info(self, tc: "DTDTaskClass", flow_accesses,
+                       arg_spec) -> Optional[tuple]:
+        """Register an engine batch class for (tc, arg interleaving), or
+        None when ineligible. Eligibility (honest-fallback contract, the
+        ptexec pattern — refusals ride the per-task lane and count in
+        PTDTD_STATS):
+          * plain READ/WRITE/RW flows only (NOTRACK snapshots the value at
+            insert time, which a deferred batch cannot honor; AFFINITY is
+            placement policy);
+          * jittable bodies take no by-value args (the batched dispatch
+            calls the class's jitted fn on payloads only);
+          * TPU contexts never reach here (pool-level gate)."""
+        if not self._batch_on:
+            return None
+        for acc in flow_accesses:
+            if acc & ~0x3:
+                PTDTD_STATS["classes_ineligible"] += 1
+                return None
+        if tc.jit_ok and any(kind != "flow" for kind, _ in arg_spec):
+            PTDTD_STATS["classes_ineligible"] += 1
+            return None
+        kinds: List[Optional[int]] = []
+        argmap: List[int] = []
+        for kind, v in arg_spec:
+            if kind == "flow":
+                kinds.append(flow_accesses[v])
+                argmap.append(v)
+            else:
+                kinds.append(None)
+                argmap.append(-1)
+        reg = getattr(tc, "_breg", None)
+        if reg is None:
+            reg = tc._breg = {}
+        key = tuple(argmap)
+        nid = reg.get(key)
+        if nid is None:
+            cb = self._mk_batch_callback(tc, key)
+            nid = self._neng.register_class(
+                cb, key, [a & 0x3 for a in flow_accesses],
+                self._batch_retire)
+            reg[key] = nid
+        return (nid, tuple(kinds))
+
+    def _flush_batch(self) -> None:
+        """Hand the buffered insert specs to the engine in one call.
+        Flushers serialize on the insert lock; the del-slice prefix drain
+        cannot race concurrent tail appends (both are GIL-atomic and the
+        fast path only ever appends)."""
+        if not self._bbuf:
+            return
+        with self._insert_lock:
+            self._flush_batch_locked()
+
+    def _flush_batch_locked(self) -> None:
+        lst = self._bbuf
+        n = len(lst)
+        if not n:
+            return
+        if self._slots_stale:
+            # a quiescence sync emptied the slots (tile.data became
+            # authoritative again, honoring any user reseed since); the
+            # next batch gathers args from the slots, so refill them from
+            # the host copies before linking
+            self._slots_stale = False
+            neng = self._neng
+            with self._tiles_lock:
+                tiles = list(self._touched_tiles)
+            for t in tiles:
+                if t.nid is not None:
+                    copy = t.data.newest_copy()
+                    if copy is not None:
+                        neng.slot_set(t.nid, copy.payload)
+        chunk = lst[:n]
+        del lst[:n]
+        # count BEFORE linking: a linked task may be drained by a worker
+        # immediately, and its -1 must never underflow the counter
+        self.addto_nb_tasks(n)
+        self.inserted += n
+        self.local_inserted += n
+        PTDTD_STATS["tasks_batched"] += n
+        PTDTD_STATS["batches"] += 1
+        try:
+            self._neng.insert_many(chunk)
+        except BaseException:
+            # insert_many validates the WHOLE batch before linking any of
+            # it, so a raise means nothing linked: roll the counters back
+            # or the pool could never quiesce (wait() would spin to its
+            # timeout on tasks that do not exist)
+            self.addto_nb_tasks(-n)
+            self.inserted -= n
+            self.local_inserted -= n
+            PTDTD_STATS["tasks_batched"] -= n
+            PTDTD_STATS["batches"] -= 1
+            raise
+
+    def _sync_slots(self) -> None:
+        """Land the engine's tile payload slots back into tile.data (the
+        slot-ownership hand-off: C owned the values while batched writers
+        were in flight; Python re-takes them at quiescence points). The
+        version delta equals the number of batched writes, keeping
+        tile.data.version in parity with the per-task lanes. slot_sync
+        also EMPTIES each slot, making tile.data authoritative until the
+        next flush re-seeds — so a user reseeding a tile's host copy
+        between waits is honored exactly like on the per-task lanes.
+
+        Runs under the insert lock (RLock — callers already holding it
+        are fine): a concurrent inserter thread's flush must never link a
+        batch against slots this sync is mid-way through emptying (the
+        drained bodies would gather None payloads), and the stale flag
+        must be set before any later flush can read it."""
+        if not self._batch_on:
+            return
+        neng = self._neng
+        with self._insert_lock:
+            with self._tiles_lock:
+                tiles = list(self._touched_tiles)
+            synced = False
+            for t in tiles:
+                nid = t.nid
+                if nid is None:
+                    continue
+                payload, writes = neng.slot_sync(nid)
+                synced = True
+                if not writes:
+                    continue
+                data = t.data
+                host = data.get_copy(0)
+                if host is None:
+                    data.create_copy(0, payload, COHERENCY_OWNED)
+                else:
+                    host.payload = payload
+                data.bump_version(0, writes)
+                t.wcount += writes
+                t.last_writer_version = t.wcount
+            if synced:
+                self._slots_stale = True
+
+    def _retire_batch_lane(self) -> None:
+        """Final-completion hand-back for batch-lane pools (fires once,
+        from on_complete): drop this pool from the context's open-batch
+        count (stream hot loops stop paying the engine drain once no
+        batch pool is live) and release the engine-side state the pool
+        pinned."""
+        if not self._batch_on or self._batch_retired:
+            return
+        self._batch_retired = True
+        with _BATCH_POOLS_LOCK:
+            self.ctx._dtd_batch_pools -= 1
+        self._release_native()
+
+    def _release_native(self) -> None:
+        """Hand the pool's engine-side references back: tile payload slots
+        and batch-class callbacks. The Engine is per-CONTEXT while pools
+        come and go — without this, every dead pool's payloads (and the
+        pool object itself, through the callback closures) stay pinned
+        until context teardown. Only called once the pool is fully drained
+        (no task of a released class can ever be ready again)."""
+        rel = getattr(self._neng, "release_pool", None)
+        if rel is None:
+            return
+        with self._tiles_lock:
+            nids = [t.nid for t in self._touched_tiles if t.nid is not None]
+        cls_ids: List[int] = []
+        for tc in self._classes.values():
+            reg = getattr(tc, "_breg", None)
+            if reg:
+                cls_ids.extend(reg.values())
+        if nids or cls_ids:
+            rel(nids, cls_ids)
+        self._fast = None
 
     def _run_lean(self, task: "DTDTask", tc: "DTDTaskClass",
                   tiles, arg_spec) -> None:
@@ -425,9 +773,14 @@ class DTDTaskpool(Taskpool):
         tiles, run eagerly, write WRITE flows back — the _cpu_hook eager
         branch without TaskData slot churn (fused-inline path only)."""
         pend = task.pending_inputs
+        batch_on = self._batch_on
         payloads = []
         for i, tile in enumerate(tiles):
             p = pend.pop(i, None) if pend else None
+            if p is None and batch_on and tile.nid is not None:
+                # batch-lane coherence: the engine slot holds the newest
+                # payload while batched writers are in flight
+                p = self._neng.slot_get(tile.nid)
             if p is None:
                 copy = tile.data.newest_copy()
                 if copy is None:
@@ -446,13 +799,19 @@ class DTDTaskpool(Taskpool):
             if acc & WRITE:
                 new = outs[oi] if oi < len(outs) else payloads[i]
                 oi += 1
-                data = tiles[i].data
+                tile = tiles[i]
+                data = tile.data
                 host = data.get_copy(0)
                 if host is None:
                     data.create_copy(0, new, COHERENCY_OWNED)
                 else:
                     host.payload = new
                 data.bump_version(0)
+                if batch_on and tile.nid is not None:
+                    # mirror into the engine slot so batched readers see
+                    # this write (slot_set bumps no batch-write counter:
+                    # the version was bumped Python-side above)
+                    self._neng.slot_set(tile.nid, new)
 
     def _lean_cycle(self, stream, task: "DTDTask") -> None:
         """The fused select-side task cycle for native-lane eager bodies:
@@ -489,7 +848,12 @@ class DTDTaskpool(Taskpool):
         self.ctx.schedule(rtasks, stream)
 
     def _flush_ready(self) -> None:
-        """Hand the buffered ready-at-insert batch to the scheduler."""
+        """Hand the buffered ready-at-insert batch to the scheduler (and
+        flush the batch-lane insert buffer: this doubles as the pool's
+        progress-loop drain hook, so starving loops always see buffered
+        work)."""
+        if self._bbuf:
+            self._flush_batch()
         if not self._ready_buf:
             return
         with self._exec_lock:
@@ -552,7 +916,31 @@ class DTDTaskpool(Taskpool):
         calls, counters, ready buffering) runs under the taskpool insert
         lock, so shared-tile chains stay exact; window flow control runs
         AFTER the lock drops (one drainer elected, see _window_stall).
+
+        Batched native lane: on a single-rank CPU context, repeat inserts
+        of an eligible class (same body fn, same flow shape — the one-
+        entry class cache) buffer their specs and link in the engine N at
+        a time; such inserts return ``None`` (no per-task Python object
+        exists — like capture mode, the handle-free contract of the
+        batched lane). The FIRST insert of a class, and any ineligible
+        insert (priority, NOTRACK/AFFINITY, device restriction, jittable
+        body with by-value args), takes the per-task path and returns the
+        task. Buffered inserts flush at window boundaries, at wait/close,
+        and whenever a progress loop starves.
         """
+        # batch-lane fast path: NO lock — the whole validate+spec-build+
+        # buffer-append collapses into one C call (native try_buffer); the
+        # list append it performs is GIL-atomic. A 0 return (unknown fn,
+        # shape mismatch, priority, device restriction, un-entered tile)
+        # falls through to the per-task slow path
+        fi = self._fast
+        if fi is not None:
+            r = self._tbuf(fi, fn, args, priority, where, jit, batch)
+            if r:
+                if r == 2:      # flush threshold reached
+                    self._flush_batch()
+                    self._window_stall()
+                return None
         with self._insert_lock:
             task = self._insert_task_locked(fn, args, priority, where, name,
                                             jit, batch)
@@ -564,6 +952,10 @@ class DTDTaskpool(Taskpool):
                             jit: bool, batch: bool) -> Optional[DTDTask]:
         if not self._open:
             output.fatal("insert_task on a closed DTD taskpool")
+        if self._bbuf:
+            # chain-order guarantee: buffered batch specs precede this
+            # task in program order, so they must link first
+            self._flush_batch_locked()
         if self._capture is not None:
             self._capture.record(fn, args, jit=jit, name=name or "")
             self.inserted += 1
@@ -589,16 +981,20 @@ class DTDTaskpool(Taskpool):
                 arg_spec.append(("value", a))
         # one-entry class cache: the dominant pattern is a loop inserting
         # the same body with the same flow shape (the reference's task
-        # class reuse), so the 5-tuple dict key is usually redundant
+        # class reuse), so the 5-tuple dict key is usually redundant.
+        # Entry 6 is the batch-lane registration (engine class id + arg
+        # kind pattern) the insert_task fast path matches against
         lc = self._last_class
         if lc is not None and lc[0] is fn and lc[1] == flow_accesses \
                 and lc[2] == len(arg_spec) and lc[3] == jit and lc[4] == batch:
             tc = lc[5]
+            binfo = lc[6]
         else:
             tc = self._class_of(fn, tuple(flow_accesses), len(arg_spec),
                                 name, jit_ok=jit, batchable=batch)
+            binfo = _BINFO_UNSET
             self._last_class = (fn, list(flow_accesses), len(arg_spec),
-                                jit, batch, tc)
+                                jit, batch, tc, None)
         task = DTDTask(self, tc, priority)
         task.arg_spec = arg_spec
         task.tiles = tiles
@@ -607,6 +1003,22 @@ class DTDTaskpool(Taskpool):
 
         neng = self._neng if self._neng_decided else self._native_engine()
         if neng is not None:
+            if self._batch_on:
+                if binfo is _BINFO_UNSET:
+                    # register (or refuse) the batch-lane class for this
+                    # arg interleaving so the NEXT insert can take the
+                    # lock-free buffered fast path
+                    binfo = self._mk_batch_info(tc, flow_accesses, arg_spec)
+                    self._last_class = (fn, list(flow_accesses),
+                                        len(arg_spec), jit, batch, tc, binfo)
+                    if binfo is not None:
+                        kinds = binfo[1]
+                        if len(kinds) == 1 and kinds[0] is not None:
+                            kinds = kinds[0]    # single-flow collapse
+                        self._fast = (fn, jit, batch, kinds, binfo[0],
+                                      self._bbuf, self._batch_flush_n,
+                                      DTDTile)
+                PTDTD_STATS["tasks_per_task"] += 1
             # single-rank: owner-computes placement is the identity — the
             # affinity scan below would always land on my_rank
             task.rank = self.ctx.my_rank
@@ -618,15 +1030,15 @@ class DTDTaskpool(Taskpool):
             nids, naccs = [], []
             for fi, (tile, acc) in enumerate(zip(tiles, flow_accesses)):
                 if acc & NOTRACK:
-                    copy = tile.data.newest_copy()
-                    if copy is not None:
+                    p = self._slot_payload(tile)
+                    if p is not None:
                         if task.pending_inputs is None:
                             task.pending_inputs = {}
-                        task.pending_inputs[fi] = copy.payload
+                        task.pending_inputs[fi] = p
                     continue
                 nid = tile.nid
                 if nid is None:
-                    nid = tile.nid = neng.tile()
+                    nid = self._tile_nid(tile)
                 nids.append(nid)
                 naccs.append(acc & 0x3)
                 if acc & WRITE:
@@ -783,10 +1195,22 @@ class DTDTaskpool(Taskpool):
             # across processes, unlike str hash under PYTHONHASHSEED): all
             # ranks replay the same COLLECTION-BACKED inserts, so the
             # chains must agree (tile_new scratch tiles are rank-local by
-            # contract and excluded)
-            import zlib
-            item = repr((tile.key, acc & 0x3, read_version, src_rank,
-                         task.rank)).encode()
+            # contract and excluded). The digest item avoids a repr()
+            # round-trip where the key is already bytes-able: collection
+            # keys are (dc.name, data_key) with int/str/tuple-of-int parts,
+            # so a %-format over the scalar fields byte-compiles the same
+            # decision without building the intermediate repr string of a
+            # nested tuple (the link-path profile showed repr+encode as
+            # the audit branch's dominant cost)
+            key = tile.key
+            if type(key) is tuple and len(key) == 2 and \
+                    isinstance(key[1], (int, str)):
+                item = b"%s\x00%a\x00%d\x00%d\x00%d\x00%d" % (
+                    key[0].encode(), key[1], acc & 0x3, read_version,
+                    src_rank, task.rank)
+            else:
+                item = repr((key, acc & 0x3, read_version, src_rank,
+                             task.rank)).encode()
             self._audit_digest = zlib.crc32(item, self._audit_digest)
             self._audit_count += 1
         if distributed:
@@ -825,8 +1249,16 @@ class DTDTaskpool(Taskpool):
             task.data = [TaskData()
                          for _ in range(task.task_class.nb_flows)]
         pending = task.pending_inputs
+        batch_on = self._batch_on
         for i, tile in enumerate(task.tiles):
             pend = pending.pop(i, None) if pending else None
+            if pend is None and batch_on and tile.nid is not None:
+                # batch-lane coherence: in-flight batched writes live in
+                # the engine slot, not yet in tile.data (synced at wait)
+                p = self._neng.slot_get(tile.nid)
+                copy = tile.data.newest_copy()
+                if p is not None and (copy is None or p is not copy.payload):
+                    pend = p
             if pend is not None:
                 # remote exact-version payload (may differ from newest_copy
                 # when versions raced in through the network out of order);
@@ -914,6 +1346,10 @@ class DTDTaskpool(Taskpool):
                 else:
                     host.payload = new
                 tile.data.bump_version(0)
+                if self._batch_on and tile.nid is not None:
+                    # keep the engine slot coherent for batched readers
+                    # (no batch-write count: version bumped above)
+                    self._neng.slot_set(tile.nid, new)
                 task.data[i].data_out = host
         return HOOK_DONE
 
@@ -1058,10 +1494,16 @@ class DTDTaskpool(Taskpool):
                                 until=lambda: self.executed >= target and
                                 self.nb_tasks == 0,
                                 timeout=timeout)
-        return self.executed >= target
+        done = self.executed >= target
+        if done:
+            # slot-ownership hand-off: batched writes land back in
+            # tile.data now that the pool is drained
+            self._sync_slots()
+        return done
 
     def close(self) -> None:
         """End of insertion: drop the open action so termination can fire."""
+        self._fast = None     # closed pools must fatal via the slow path
         if self._capture is not None and self._capture.ops:
             # scheduler-mode inserts execute without an explicit wait();
             # captured ops must not be silently dropped on close

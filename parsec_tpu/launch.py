@@ -11,14 +11,64 @@ test mode).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Optional
 
 from .comm.tcp import ENV_NPROCS, ENV_RANK, ENV_RDV, _free_port
+
+_MULTIPROC_LOCK_PATH = os.path.join(tempfile.gettempdir(),
+                                    "parsec_tpu_multiproc.lock")
+
+
+@contextlib.contextmanager
+def multiproc_lock(timeout: float = 300.0):
+    """Serialize multiproc phases across SESSIONS on one host (lock-file).
+
+    Spawned-rank jobs are the one test class that cannot tolerate a busy
+    host: every rank pays a full interpreter+jax import before it can
+    rendezvous, so two concurrent multiproc jobs (e.g. a background full
+    suite plus a foreground test run) push each other past their
+    deadlines and flap. Taking this advisory flock around each job makes
+    the host run them one at a time; a holder that outlives ``timeout``
+    degrades to running unserialized (never deadlocks on a dead peer's
+    stale lock — flock dies with its process anyway).
+
+    Ranks themselves (PARSEC_TPU_RANK set) skip the lock: the parent job
+    already holds it, and a child blocking on it would self-deadlock.
+    """
+    if os.environ.get(ENV_RANK) is not None:
+        yield
+        return
+    try:
+        f = open(_MULTIPROC_LOCK_PATH, "a+b")
+    except OSError:
+        yield                     # unwritable tmp: run unserialized
+        return
+    try:
+        import fcntl
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    break         # degrade rather than queue forever
+                time.sleep(0.2)
+        yield
+    finally:
+        try:
+            import fcntl
+            fcntl.flock(f, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        f.close()
 
 
 def main(argv=None) -> int:
@@ -64,6 +114,11 @@ def main(argv=None) -> int:
         except Exception:
             accel_ok = False
 
+    with multiproc_lock():
+        return _run_job(opts, accel_ok, accel_count)
+
+
+def _run_job(opts, accel_ok: bool, accel_count: int) -> int:
     rdv = f"127.0.0.1:{_free_port()}"
     procs = []
     for rank in range(opts.nprocs):

@@ -1,0 +1,574 @@
+"""DTD batched native insert lane (ISSUE 4): engine insert_many /
+drain_ready semantics, the insert_task fast path, three-way lane parity
+(native-batched vs per-task engine vs pure-Python linker), and concurrent
+inserters with the batch buffer enabled.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu import native as native_mod
+from parsec_tpu.dsl.dtd import (
+    DTDTaskpool, NOTRACK, PTDTD_STATS, READ, RW, WRITE,
+)
+from parsec_tpu.utils import mca
+
+
+def _batch_ready():
+    mod = native_mod.load_ptdtd()
+    return mod is not None and hasattr(mod.Engine, "insert_many")
+
+
+pytestmark = pytest.mark.skipif(not _batch_ready(),
+                                reason="native _ptdtd v2 unavailable")
+
+
+# hoisted bodies: the batch lane engages on REPEAT inserts of one fn
+# object — a fresh lambda per loop iteration never batches
+def _inc(a):
+    return a + 1.0
+
+
+def _axpy(x, y):
+    return y + 2.0 * x
+
+
+def _scale_by(a, s):
+    return a * s
+
+
+def _observe(a):
+    return None
+
+
+@pytest.fixture()
+def ctx():
+    c = pt.Context(nb_cores=1)
+    yield c
+    c.fini()
+
+
+# ---------------------------------------------------------------- engagement
+
+def test_batch_lane_engages_and_returns_none(ctx):
+    tp = DTDTaskpool(ctx, "bl")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    b0 = PTDTD_STATS["tasks_batched"]
+    first = tp.insert_task(_inc, (t, RW), jit=False)
+    assert first is not None, "first insert of a class takes the per-task path"
+    for _ in range(100):
+        assert tp.insert_task(_inc, (t, RW), jit=False) is None, \
+            "batched inserts are handle-free"
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=30)
+    assert PTDTD_STATS["tasks_batched"] - b0 == 100
+    np.testing.assert_allclose(
+        np.asarray(t.data.newest_copy().payload), 101.0)
+    assert t.data.version == 101
+
+
+def test_batch_lane_off_when_disabled(ctx):
+    mca.set("dtd_batch_insert", False)
+    try:
+        tp = DTDTaskpool(ctx, "bloff")
+        t = tp.tile_new((2, 2), np.float32)
+        t.data.create_copy(0, np.zeros((2, 2), np.float32))
+        for _ in range(10):
+            assert tp.insert_task(_inc, (t, RW), jit=False) is not None
+        assert not tp._batch_on
+        tp.wait()
+        tp.close()
+        ctx.wait(timeout=30)
+    finally:
+        mca.params.unset("dtd_batch_insert")
+
+
+def test_batch_fallbacks_stay_honest(ctx):
+    """Ineligible inserts (priority, NOTRACK, by-value args on jittable
+    bodies) ride the per-task lane — counted, never silently wrong."""
+    tp = DTDTaskpool(ctx, "bf")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    p0 = PTDTD_STATS["tasks_per_task"]
+    # NOTRACK class: insert-time snapshot — batch-ineligible by design
+    for _ in range(5):
+        assert tp.insert_task(_observe, (t, READ | NOTRACK),
+                              jit=False) is not None
+    # prioritized insert of an otherwise-batchable class
+    tp.insert_task(_inc, (t, RW), jit=False)           # registers the class
+    assert tp.insert_task(_inc, (t, RW), jit=False, priority=3) is not None
+    assert PTDTD_STATS["tasks_per_task"] - p0 >= 7
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 2.0)
+
+
+def test_batch_values_args(ctx):
+    """By-value args on eager bodies buffer per task through the spec's
+    values tuple."""
+    tp = DTDTaskpool(ctx, "bv")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.ones((2, 2), np.float32))
+    tp.insert_task(_scale_by, (t, RW), 2.0, jit=False)   # per-task (first)
+    for _ in range(6):
+        assert tp.insert_task(_scale_by, (t, RW), 2.0, jit=False) is None
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload),
+                               2.0 ** 7)
+
+
+def test_batch_error_surfaces_at_wait(ctx):
+    def bad(a):
+        raise ValueError("intentional-batch")
+
+    tp = DTDTaskpool(ctx, "be")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    for _ in range(10):
+        tp.insert_task(bad, (t, RW), jit=False)
+    with pytest.raises(ValueError, match="intentional-batch"):
+        tp.wait(timeout=10)
+    # the context stays poisoned: fini skips the drain (the errored
+    # batch's tasks never retire) and tears down cleanly — the same
+    # contract as the per-task native lane
+    tp.close()
+
+
+def test_mixed_lane_chain_order(ctx):
+    """Eligible (batched) and ineligible (fresh-lambda, per-task) inserts
+    interleaved on ONE tile must serialize in program order: the slow
+    path flushes the batch buffer before linking."""
+    tp = DTDTaskpool(ctx, "mx")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    # accumulate the oracle in float32 so it rounds exactly like the tile
+    expected = np.float32(0.0)
+    tp.insert_task(_inc, (t, RW), jit=False)
+    expected += np.float32(1.0)
+    for k in range(30):
+        for _ in range(5):
+            tp.insert_task(_inc, (t, RW), jit=False)     # batched
+            expected += np.float32(1.0)
+        # a fresh lambda never matches the class cache -> per-task lane
+        tp.insert_task(lambda a: a * 2.0, (t, RW), jit=False)
+        expected *= np.float32(2.0)
+    tp.wait()
+    tp.close()
+    ctx.wait(timeout=60)
+    np.testing.assert_allclose(
+        float(np.asarray(t.data.newest_copy().payload)[0, 0]), float(expected))
+
+
+def test_batch_recursive_insert_from_body(ctx):
+    """A batched body that itself inserts (same hoisted child class) must
+    not deadlock or lose tasks: the engine mutex is released around the
+    callback and the child rides the buffer."""
+    tp = DTDTaskpool(ctx, "rec")
+    parent_t = tp.tile_new((2, 2), np.float32)
+    child_t = tp.tile_new((2, 2), np.float32)
+    parent_t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    child_t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    n = 50
+
+    def parent(a):
+        tp.insert_task(_inc, (child_t, RW), jit=False)
+        return a + 1.0
+
+    for _ in range(n):
+        tp.insert_task(parent, (parent_t, RW), jit=False)
+    assert tp.wait(timeout=60)
+    # children inserted from bodies may still be in flight counters-wise;
+    # wait drains until nb_tasks==0, so both chains are done here
+    tp.close()
+    ctx.wait(timeout=30)
+    assert float(np.asarray(parent_t.data.newest_copy().payload)[0, 0]) == n
+    assert float(np.asarray(child_t.data.newest_copy().payload)[0, 0]) == n
+
+
+# ------------------------------------------------- engine-level contracts
+
+def test_engine_retire_fires_after_outputs_land():
+    """The retire callback runs AFTER drain_ready phase 3: every retire
+    must already see its batch's outputs in the tile slot (retiring any
+    earlier would let a waiter sync stale payloads)."""
+    eng = native_mod.load_ptdtd().Engine()
+    nid = eng.tile()
+    eng.slot_set(nid, 0.0)
+    seen = []
+
+    def cb(args_list):
+        return [(v + 1.0,) for (v,) in args_list]
+
+    def retire(n):
+        seen.append((n, eng.slot_get(nid)))
+
+    cls = eng.register_class(cb, [0], [RW], retire)
+    eng.insert_many([(cls, None, nid, RW)] * 5)
+    total = 0
+    while total < 5:
+        n, surfaced = eng.drain_ready(256, 4096)
+        assert surfaced == ()
+        if n == 0:
+            break
+        total += n
+    assert total == 5
+    assert sum(n for n, _ in seen) == 5
+    landed = 0.0
+    for n, payload in seen:
+        landed += n
+        assert payload == landed, "retire observed a pre-landing slot"
+
+
+def test_engine_release_pool_drops_refs():
+    import sys
+
+    eng = native_mod.load_ptdtd().Engine()
+    nid = eng.tile()
+    payload = np.ones((2, 2), np.float32)
+    eng.slot_set(nid, payload)
+    cls = eng.register_class(lambda args_list: None, [0], [READ])
+    rc_held = sys.getrefcount(payload)
+    eng.release_pool([nid], [cls])
+    assert eng.slot_get(nid) is None
+    assert sys.getrefcount(payload) == rc_held - 1
+
+
+# ------------------------------------------------- pool lifecycle contracts
+
+def test_on_complete_chained_not_clobbered(ctx):
+    """A completion hook set BEFORE the lane arms (the recursive-device /
+    compound-stage pattern) must still fire — and see the synced
+    tile.data, not the pre-batch values."""
+    tp = DTDTaskpool(ctx, "oc")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    fired = []
+    tp.on_complete = lambda pool: fired.append(
+        float(np.asarray(t.data.newest_copy().payload)[0, 0]))
+    for _ in range(20):
+        tp.insert_task(_inc, (t, RW), jit=False)
+    assert tp._batch_on
+    tp.wait(timeout=30)
+    tp.close()
+    ctx.wait(timeout=30)
+    assert fired == [20.0]
+
+
+def test_batch_pool_releases_engine_state(ctx):
+    """Final completion hands the engine-side state back: the context's
+    open-batch count returns to zero (later pools stop paying the idle
+    drain) and the pool's slot payloads are dropped from the engine."""
+    tp = DTDTaskpool(ctx, "rel")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    for _ in range(20):
+        tp.insert_task(_inc, (t, RW), jit=False)
+    assert ctx._dtd_batch_pools == 1
+    tp.wait(timeout=30)
+    tp.close()
+    ctx.wait(timeout=30)
+    assert tp._batch_retired
+    assert ctx._dtd_batch_pools == 0
+    # slot payload dropped; reads fall back to the synced tile.data
+    assert tp._neng.slot_get(t.nid) is None
+    np.testing.assert_allclose(
+        np.asarray(t.data.newest_copy().payload), 20.0)
+
+
+# ------------------------------------------------------------ parity harness
+
+def _random_program(seed, nops=400, ntiles=6):
+    """A reproducible random access pattern over shared tiles, exercising
+    RAW/WAR/WAW chains, multi-flow bodies, and value args with HOISTED
+    fns (so the batch lane engages on the batched run)."""
+    rng = np.random.default_rng(seed)
+    ops = []
+    for _ in range(nops):
+        kind = rng.integers(0, 4)
+        a = int(rng.integers(0, ntiles))
+        b = int(rng.integers(0, ntiles))
+        ops.append((int(kind), a, b))
+    return ops
+
+
+def _run_program(ctx, ops, ntiles=6, audit=False):
+    tp = DTDTaskpool(ctx, "par")
+    tiles = [tp.tile_new((2, 2), np.float32) for _ in range(ntiles)]
+    for i, t in enumerate(tiles):
+        t.data.create_copy(0, np.full((2, 2), float(i), np.float32))
+    for kind, a, b in ops:
+        if kind == 0:
+            tp.insert_task(_inc, (tiles[a], RW), jit=False)
+        elif kind == 1:
+            tp.insert_task(_observe, (tiles[a], READ), jit=False)
+        elif kind == 2 and a != b:
+            tp.insert_task(_axpy, (tiles[a], READ), (tiles[b], RW),
+                           jit=False)
+        else:
+            tp.insert_task(_scale_by, (tiles[a], RW), 1.5, jit=False)
+    tp.wait(timeout=120)
+    tp.close()
+    ctx.wait(timeout=60)
+    payloads = [np.asarray(t.data.newest_copy().payload).copy()
+                for t in tiles]
+    versions = [t.data.version for t in tiles]
+    wcounts = [t.wcount for t in tiles]
+    survivors = [len(t.readers) for t in tiles]
+    return {"payloads": payloads, "versions": versions, "wcounts": wcounts,
+            "survivors": survivors, "executed": tp.executed,
+            "inserted": tp.inserted, "batch_on": tp._batch_on,
+            "digest": tp._audit_digest}
+
+
+@pytest.mark.parametrize("seed", [7, 41, 1234])
+def test_three_way_lane_parity(seed):
+    """native-batched vs per-task engine vs pure-Python linker on one
+    random program: identical completion counts, tile payloads, tile
+    versions — and identical reader-compaction survivors between the two
+    per-task modes (the batched lane keeps no per-task mirror)."""
+    ops = _random_program(seed)
+
+    def run(mode):
+        if mode == "batched":
+            pass
+        elif mode == "pertask":
+            mca.set("dtd_batch_insert", False)
+        else:
+            mca.set("native_enabled", False)
+        try:
+            c = pt.Context(nb_cores=1)
+            try:
+                return _run_program(c, ops)
+            finally:
+                c.fini()
+        finally:
+            mca.params.unset("dtd_batch_insert")
+            mca.params.unset("native_enabled")
+
+    rb = run("batched")
+    rp = run("pertask")
+    rpy = run("python")
+    assert rb["batch_on"] and not rp["batch_on"] and not rpy["batch_on"]
+    for ref in (rp, rpy):
+        assert rb["inserted"] == ref["inserted"]
+        assert rb["executed"] == ref["executed"], \
+            (rb["executed"], ref["executed"])
+        assert rb["versions"] == ref["versions"]
+        assert rb["wcounts"] == ref["wcounts"]
+        for pa, pb in zip(rb["payloads"], ref["payloads"]):
+            np.testing.assert_allclose(pa, pb)
+    # reader-compaction survivors: the per-task native mirror replicates
+    # the Python engine's list + watermark policy exactly
+    assert rp["survivors"] == rpy["survivors"]
+
+
+def test_audit_digest_deterministic_and_unperturbed():
+    """The replay auditor (pure-Python lane) digests the same program to
+    the same crc32 on repeated runs — covering the zlib-hoist/bytes-path
+    refactor — and collection-backed keys take the fast byte path."""
+    from parsec_tpu.data.matrix import TiledMatrix
+
+    def run():
+        mca.set("dtd_audit", True)
+        try:
+            c = pt.Context(nb_cores=1)
+            try:
+                m = TiledMatrix("pm", 4, 4, 2, 2)
+                m.fill(lambda i, j: np.zeros((2, 2), np.float32))
+                tp = DTDTaskpool(c, "aud")
+                for k in range(40):
+                    t = tp.tile_of(m, k % 2, (k // 2) % 2)
+                    tp.insert_task(_inc, (t, RW), jit=False)
+                tp.wait(timeout=60)
+                tp.close()
+                c.wait(timeout=30)
+                assert tp._audit_count == 40
+                return tp._audit_digest
+            finally:
+                c.fini()
+        finally:
+            mca.params.unset("dtd_audit")
+
+    d1 = run()
+    d2 = run()
+    assert d1 == d2 and d1 != 0
+
+
+# ------------------------------------------------------- concurrent inserters
+
+def test_concurrent_inserters_batched_shared_tiles():
+    """THREE user threads hammer the SAME tiles through the batch buffer:
+    the GIL-atomic spec appends, the locked flushes, and the engine-mutex
+    linking must keep every chain exact (final sum == total inserts)."""
+    c = pt.Context(nb_cores=1)
+    try:
+        tp = DTDTaskpool(c, "cc")
+        shared = [tp.tile_new((2, 2), np.float32) for _ in range(4)]
+        for t in shared:
+            t.data.create_copy(0, np.zeros((2, 2), np.float32))
+        # register the class so every thread takes the fast path
+        tp.insert_task(_inc, (shared[0], RW), jit=False)
+        per_thread, nthreads = 1500, 3
+        barrier = threading.Barrier(nthreads)
+
+        def inserter(tid):
+            barrier.wait()
+            for i in range(per_thread):
+                tp.insert_task(_inc, (shared[(tid + i) % 4], RW), jit=False)
+
+        threads = [threading.Thread(target=inserter, args=(k,))
+                   for k in range(nthreads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tp.wait(timeout=120)
+        tp.close()
+        c.wait(timeout=60)
+        total = sum(float(np.asarray(t.data.newest_copy().payload)[0, 0])
+                    for t in shared)
+        assert total == nthreads * per_thread + 1, total
+        assert tp.executed == nthreads * per_thread + 1
+        assert tp.inserted == tp.local_inserted == nthreads * per_thread + 1
+    finally:
+        c.fini()
+
+
+def test_concurrent_inserters_batched_with_live_workers():
+    """Concurrent batched inserters racing LIVE worker drains: the GIL-
+    free insert_many link walk overlaps complete()/drain_ready calls; no
+    task may be lost or run twice."""
+    c = pt.Context(nb_cores=2)
+    try:
+        tp = DTDTaskpool(c, "cw")
+        assert tp._native_engine() is not None
+        c.start()
+        tiles = {k: [tp.tile_new((2, 2), np.float32) for _ in range(4)]
+                 for k in range(2)}
+        for tl in tiles.values():
+            for t in tl:
+                t.data.create_copy(0, np.zeros((2, 2), np.float32))
+        tp.insert_task(_inc, (tiles[0][0], RW), jit=False)
+        per_thread = 4000
+
+        def inserter(tid):
+            for i in range(per_thread):
+                tp.insert_task(_inc, (tiles[tid][i % 4], RW), jit=False)
+
+        threads = [threading.Thread(target=inserter, args=(k,))
+                   for k in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tp.wait(timeout=180)
+        tp.close()
+        c.wait(timeout=60)
+        total = sum(float(np.asarray(t.data.newest_copy().payload)[0, 0])
+                    for tl in tiles.values() for t in tl)
+        assert total == 2 * per_thread + 1, total
+    finally:
+        c.fini()
+
+
+def test_batch_window_pressure():
+    """Tiny window: the flush threshold shrinks with it and the inserter
+    stalls/drains mid-insertion; counts and results stay exact."""
+    mca.set("dtd_window_size", 32)
+    mca.set("dtd_threshold_size", 16)
+    c = pt.Context(nb_cores=1)
+    try:
+        tp = DTDTaskpool(c, "wp")
+        t = tp.tile_new((2, 2), np.float32)
+        t.data.create_copy(0, np.zeros((2, 2), np.float32))
+        n = 600
+        for _ in range(n):
+            tp.insert_task(_inc, (t, RW), jit=False)
+        assert tp.window_stalls > 0, "window never engaged"
+        tp.wait(timeout=60)
+        tp.close()
+        c.wait(timeout=30)
+        np.testing.assert_allclose(
+            np.asarray(t.data.newest_copy().payload), float(n))
+        assert tp.executed == n
+    finally:
+        mca.params.unset("dtd_window_size")
+        mca.params.unset("dtd_threshold_size")
+        c.fini()
+
+
+def test_tile_reseed_between_waits_is_honored(ctx):
+    """After a wait() quiescence the HOST copy is authoritative again: a
+    user reseeding tile.data (the documented seeding API) must be seen by
+    the next round of batched tasks, exactly like on the per-task lanes.
+    Regression: the engine slot used to outrank tile.data forever once
+    seeded, silently computing on the pre-reseed payload."""
+    tp = DTDTaskpool(ctx, "reseed")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    for _ in range(10):
+        tp.insert_task(_inc, (t, RW), jit=False)
+    assert tp.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 10.0)
+    # user reseeds the host copy between quiescence points
+    t.data.get_copy(0).payload = np.zeros((2, 2), np.float32)
+    for _ in range(10):
+        tp.insert_task(_inc, (t, RW), jit=False)
+    assert tp.wait(timeout=30)
+    tp.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 10.0)
+
+
+class _FlushBoom:
+    """Engine proxy whose insert_many raises once — the flush-failure
+    rollback path (everything else delegates)."""
+
+    def __init__(self, real):
+        self._real = real
+        self.armed = True
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+    def insert_many(self, specs):
+        if self.armed:
+            self.armed = False
+            raise MemoryError("intentional-flush-boom")
+        return self._real.insert_many(specs)
+
+
+def test_flush_failure_rolls_back_counters(ctx):
+    """A failed insert_many links NOTHING (it validates the whole batch
+    first), so the pre-counted nb_tasks/inserted must roll back — or the
+    pool could never quiesce."""
+    tp = DTDTaskpool(ctx, "fboom")
+    t = tp.tile_new((2, 2), np.float32)
+    t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    tp.insert_task(_inc, (t, RW), jit=False)      # registers the class
+    for _ in range(5):
+        tp.insert_task(_inc, (t, RW), jit=False)  # buffered
+    assert len(tp._bbuf) == 5
+    boom = _FlushBoom(tp._neng)
+    tp._neng = boom
+    with pytest.raises(MemoryError):
+        tp._flush_batch()
+    tp._neng = boom._real
+    assert not boom.armed
+    ins_after = tp.inserted
+    # the 5 buffered specs were dropped with their counters rolled back:
+    # the pool must still quiesce on the 1 per-task insert alone
+    assert tp.wait(timeout=30)
+    tp.close()
+    ctx.wait(timeout=30)
+    assert tp.inserted == ins_after == 1
+    assert tp.nb_tasks == 0
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 1.0)

@@ -15,9 +15,17 @@ import numpy as np
 import pytest
 
 from parsec_tpu.comm.tcp import run_distributed_procs
+from parsec_tpu.comm.xhost import XHostTransfer
 
 N, TS = 32, 16
 _SEED = 11
+
+# device-native cross-rank pulls need the PJRT transfer API; without it
+# these cases are env-impossible — skip like test_xhost.py does instead
+# of spending a spawned-rank job discovering the same ImportError
+_needs_transfer = pytest.mark.skipif(
+    not XHostTransfer.available(),
+    reason="jax.experimental.transfer unavailable")
 
 
 # -------------------------------------------------- failure attribution unit
@@ -126,6 +134,35 @@ def test_tcp_am_roundtrip_and_barrier():
     for rank, (src, hdr_from, val) in enumerate(res):
         expect = (rank - 1) % 3
         assert src == expect and hdr_from == expect and val == expect
+
+
+def _quiet_lull_program(rank, ce):
+    """A >2s traffic lull, then a normal AM exchange: the dialed socket
+    must survive the silence. Regression — create_connection's 2s dial
+    timeout used to persist on the socket, so the dialed end's reader
+    misread any compile-length lull as peer death (the symmetric
+    'connection lost without clean shutdown' full-suite flake)."""
+    import time
+    got = []
+    from parsec_tpu.comm.engine import TAG_DSL_BASE
+    ce.tag_register(TAG_DSL_BASE,
+                    lambda _ce, src, hdr, pl: got.append(src))
+    ce.sync()
+    time.sleep(2.6)               # longer than the dial timeout
+    assert not ce.dead_peers, f"lull killed peers: {ce.dead_peers}"
+    ce.send_am(TAG_DSL_BASE, (rank + 1) % ce.nb_ranks, {}, None)
+    t0 = time.time()
+    while not got and time.time() - t0 < 20:
+        ce.progress()
+        time.sleep(0.001)
+    ce.sync()
+    ce.fini()
+    return got[0]
+
+
+def test_tcp_mesh_survives_quiet_lull():
+    res = run_distributed_procs(2, _quiet_lull_program, timeout=90)
+    assert res == [1, 0]
 
 
 def _gemm_program(rank, ce):
@@ -241,6 +278,7 @@ def test_tcp_distributed_device_module_gemm():
             tile, ref[m*TS:(m+1)*TS, n*TS:(n+1)*TS], rtol=1e-3, atol=1e-3)
 
 
+@_needs_transfer
 def test_launcher_virtual_device_binding():
     """The launcher CLI maps rank i -> local device i (--virtual-devices):
     each spawned process binds a distinct virtual chip and executes its
@@ -532,6 +570,7 @@ def _xhost_program_enabled(rank, ce):
     return _xhost_program(rank, ce)
 
 
+@_needs_transfer
 def test_tcp_xhost_device_payload_pull():
     """comm_device_mem=1: device payloads cross OS ranks via PJRT pull —
     zero host materializations, pins retired by the ACK."""
@@ -611,6 +650,7 @@ def _potrf_device_xhost_program(rank, ce):
     return dict(stats, err=err)
 
 
+@_needs_transfer
 def test_tcp_distributed_potrf_device_payloads_via_xhost():
     """End-to-end: the remote-dep protocol's PRODUCED tile payloads
     (device-resident jit outputs) cross OS ranks via PJRT pulls; results
