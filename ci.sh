@@ -39,6 +39,7 @@ from parsec_tpu.data.matrix import TiledMatrix
 from parsec_tpu.dsl.ptg.compiler import compile_ptg, PTEXEC_STATS
 
 ctx = pt.Context(nb_cores=1)
+snap = PTEXEC_STATS.snapshot()
 # dependent-chain micro-bench shape (CTL)
 chain = compile_ptg(
     "%global NT\n%global DEPTH\n"
@@ -64,10 +65,10 @@ ctx.add_taskpool(tp2); ctx.wait(timeout=60)
 assert tp2._ptexec_state is not None, \
     "data-flow chain pool fell back to Python FSM"
 assert tp2._ptexec_state["graph"].done()
-assert PTEXEC_STATS["pools_engaged"] >= 2 and \
-    PTEXEC_STATS["pools_fallback"] == 0, PTEXEC_STATS
+delta = PTEXEC_STATS.delta(snap)
+assert delta["pools_engaged"] >= 2 and delta["pools_fallback"] == 0, delta
 ctx.fini()
-print(f"native lane engagement OK: {PTEXEC_STATS}")
+print(f"native lane engagement OK: {delta}")
 EOF
 
 echo "== DTD batched lane engagement smoke =="
@@ -83,6 +84,7 @@ def inc(a):
     return a + 1.0
 
 ctx = pt.Context(nb_cores=1)
+snap = PTDTD_STATS.snapshot()
 tp = DTDTaskpool(ctx, "ci-dtd")
 tiles = [tp.tile_new((2, 2), np.float32) for _ in range(8)]
 for t in tiles:
@@ -90,15 +92,61 @@ for t in tiles:
 for i in range(512):
     tp.insert_task(inc, (tiles[i % 8], RW), jit=False)
 tp.wait(timeout=60); tp.close(); ctx.wait(timeout=60)
-assert PTDTD_STATS["pools_batch"] >= 1, PTDTD_STATS
+delta = PTDTD_STATS.delta(snap)
+assert delta["pools_batch"] >= 1, delta
 # one per-task insert registers the class; the rest must ride the batch
-assert PTDTD_STATS["tasks_batched"] >= 500, PTDTD_STATS
-assert PTDTD_STATS["tasks_per_task"] <= 8, PTDTD_STATS
+assert delta["tasks_batched"] >= 500, delta
+assert delta["tasks_per_task"] <= 8, delta
 for t in tiles:
     assert float(np.asarray(t.data.newest_copy().payload)[0, 0]) == 64.0, \
         "batched RW chains lost writes"
 ctx.fini()
-print(f"DTD batched lane engagement OK: {PTDTD_STATS}")
+print(f"DTD batched lane engagement OK: {delta}")
+EOF
+
+echo "== traced native-lane smoke (observer-effect gate) =="
+# profiling must NOT eject pools from the native lanes (PR 5): a traced
+# chain run keeps the same engagement as an untraced one, writes a .pbp
+# whose native per-worker streams hold every lane task, and drops nothing
+JAX_PLATFORMS=cpu timeout 120 python3 - <<'EOF'
+import os, tempfile
+import parsec_tpu as pt
+from parsec_tpu.dsl.ptg.compiler import compile_ptg, PTEXEC_STATS
+from parsec_tpu.utils.trace import Profiling
+from parsec_tpu.tools.trace_reader import read_pbp, to_chrome_trace, to_dataframe
+
+src = ("%global NT\n%global DEPTH\n"
+       "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. DEPTH-1\n"
+       "  CTL S <- (l > 0) ? S T(i, l-1)\n"
+       "        -> (l < DEPTH-1) ? S T(i, l+1)\nBODY\n  pass\nEND\n")
+prog = compile_ptg(src, "ci-traced")
+NT, DEPTH = 64, 16
+
+def run(ctx, tag):
+    snap = PTEXEC_STATS.snapshot()
+    tp = prog.instantiate(ctx, globals={"NT": NT, "DEPTH": DEPTH},
+                          collections={}, name=f"ci-traced-{tag}")
+    ctx.add_taskpool(tp); ctx.wait(timeout=60)
+    return PTEXEC_STATS.delta(snap)
+
+ctx = pt.Context(nb_cores=1)
+plain = run(ctx, "plain"); ctx.fini()
+ctx = pt.Context(nb_cores=1)
+ctx.profiling = Profiling()
+traced = run(ctx, "on"); ctx.fini()
+assert traced == plain, f"profiling changed lane engagement: {plain} vs {traced}"
+assert ctx._ntrace is not None and ctx._ntrace.dropped() == 0, "ring drops in smoke"
+path = os.path.join(tempfile.mkdtemp(), "ci.pbp")
+ctx.profiling.dump(path)
+trace = read_pbp(path)
+assert any(s["name"].startswith("ptexec-w") for s in trace.streams), \
+    "no native worker streams in the trace"
+df = to_dataframe(trace)
+ntask = len(df[df["name"] == "ptexec::task"])
+assert ntask == NT * DEPTH, f"native task intervals {ntask} != {NT*DEPTH}"
+assert len([e for e in to_chrome_trace(trace)["traceEvents"]
+            if e["ph"] == "X"]) >= ntask
+print(f"traced smoke OK: engagement {traced}, {ntask} native task intervals, 0 drops")
 EOF
 
 echo "== byte-compile lint (syntax over the whole tree) =="

@@ -60,10 +60,18 @@
 #include <new>
 #include <vector>
 
+#include "ptrace_ring.h"
+
 namespace {
 
 constexpr int32_t ACC_READ = 0x1;    // mirrors dsl/dtd.py READ
 constexpr int32_t ACC_WRITE = 0x2;   // mirrors dsl/dtd.py WRITE
+
+// in-lane trace event keys (registered in the PBP dictionary by
+// utils/native_trace.py; ring contract in ptrace_ring.h)
+constexpr uint32_t EV_LINK = 1;   // one interval per insert_many link batch
+constexpr uint32_t EV_EXEC = 2;   // one interval per (class, batch) dispatch
+constexpr uint32_t EV_TASK = 3;   // one point per batch-lane task completion
 
 constexpr Py_ssize_t PT_FLOWS_MAX = 64;
 
@@ -108,6 +116,8 @@ struct Engine {
     int64_t live;                 // inserted - completed
     int64_t batch_done;           // batch-lane tasks executed (diagnostics)
     bool poisoned;                // a batch callback raised
+    // in-lane event rings (null until trace_enable)
+    std::atomic<ptrace_ring::State *> trace;
 };
 
 PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
@@ -124,6 +134,7 @@ PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
     self->live = 0;
     self->batch_done = 0;
     self->poisoned = false;
+    new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
     if (!self->mu || !self->tasks || !self->tiles || !self->classes ||
         !self->flow_tile || !self->flow_acc || !self->ready) {
         Py_DECREF(self);
@@ -151,6 +162,7 @@ void engine_dealloc(PyObject *obj) {
     delete self->flow_tile;
     delete self->flow_acc;
     delete self->ready;
+    delete self->trace.load(std::memory_order_acquire);
     Py_TYPE(obj)->tp_free(obj);
 }
 
@@ -540,7 +552,10 @@ PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
     Py_DECREF(fast);   // specs' vals survive via the INCREF above
 
     // the whole batch links under ONE GIL drop
+    ptrace_ring::Writer tw;
+    tw.open(self->trace.load(std::memory_order_acquire));
     PyThreadState *ts = PyEval_SaveThread();
+    if (tw.st) tw.rec(EV_LINK, (int64_t)ntask, ptrace_ring::FLAG_START);
     {
         std::lock_guard<std::mutex> lk(*self->mu);
         std::vector<TaskRec> &tasks = *self->tasks;
@@ -563,6 +578,7 @@ PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
                 self->ready->push_back(tid);
         }
     }
+    if (tw.st) tw.rec(EV_LINK, (int64_t)ntask, ptrace_ring::FLAG_END);
     PyEval_RestoreThread(ts);
     return PyLong_FromSsize_t(ntask);
 }
@@ -586,6 +602,8 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
         return nullptr;
     if (max_batch <= 0) max_batch = 256;
     long long total = 0;
+    ptrace_ring::Writer tw;
+    tw.open(self->trace.load(std::memory_order_acquire));
     std::vector<int64_t> surfaced;
     // (cls, tid) pairs: cls is snapshotted while the pops hold the mutex —
     // a concurrent insert_many links with the GIL DROPPED (mutex held) and
@@ -678,6 +696,7 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
                 }
             }
             // phase 2 (mutex released): build the args list and dispatch
+            if (tw.st) tw.rec(EV_EXEC, cls, ptrace_ring::FLAG_START);
             PyObject *args_list = PyList_New((Py_ssize_t)gn);
             PyObject *outs = nullptr;
             size_t consumed = 0;       // argref rows moved into tuples
@@ -758,10 +777,14 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
                         defer_decref.push_back(rec.vals);
                         rec.vals = nullptr;
                     }
+                    if (tw.st)
+                        tw.rec(EV_TASK, local[t].second,
+                               ptrace_ring::FLAG_POINT);
                     complete_locked(self, local[t].second, surfaced);
                 }
                 self->batch_done += (int64_t)gn;
             }
+            if (tw.st) tw.rec(EV_EXEC, cls, ptrace_ring::FLAG_END);
             for (PyObject *p : defer_decref) Py_DECREF(p);
             Py_DECREF(args_list);
             Py_DECREF(outs);
@@ -940,6 +963,63 @@ fail:
 
 // ------------------------------------------------------------- diagnostics
 
+// successors(task_id) -> tuple of successor ids discovered so far.
+// Complete BEFORE calling complete() on the task: the release walk moves
+// the list out. Instrumentation consumers (the DOT grapher's PINS hook)
+// mirror these onto the Python task so the native lane's DAG stays
+// observable without re-running the discovery in Python.
+PyObject *engine_successors(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    int64_t tid = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return nullptr;
+    std::vector<int64_t> succs;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (tid < 0 || (size_t)tid >= self->tasks->size()) {
+            PyErr_SetString(PyExc_IndexError, "bad task id");
+            return nullptr;
+        }
+        succs = (*self->tasks)[(size_t)tid].succs;
+    }
+    PyObject *tup = PyTuple_New((Py_ssize_t)succs.size());
+    if (!tup) return nullptr;
+    for (size_t i = 0; i < succs.size(); i++) {
+        PyObject *v = PyLong_FromLongLong(succs[i]);
+        if (!v) { Py_DECREF(tup); return nullptr; }
+        PyTuple_SET_ITEM(tup, (Py_ssize_t)i, v);
+    }
+    return tup;
+}
+
+// ------------------------------------------------------- in-lane tracing
+
+PyObject *engine_trace_enable(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    return ptrace_ring::py_trace_enable(self->trace, args);
+}
+
+PyObject *engine_trace_disable(PyObject *obj, PyObject *) {
+    return ptrace_ring::py_trace_disable(
+        reinterpret_cast<Engine *>(obj)->trace.load(
+            std::memory_order_acquire));
+}
+
+PyObject *engine_trace_drain(PyObject *obj, PyObject *) {
+    return ptrace_ring::py_trace_drain(
+        reinterpret_cast<Engine *>(obj)->trace.load(
+            std::memory_order_acquire));
+}
+
+PyObject *engine_trace_dropped(PyObject *obj, PyObject *) {
+    return ptrace_ring::py_trace_dropped(
+        reinterpret_cast<Engine *>(obj)->trace.load(
+            std::memory_order_acquire));
+}
+
+PyObject *engine_monotonic_ns(PyObject *, PyObject *) {
+    return PyLong_FromLongLong(ptrace_ring::now_ns());
+}
+
 // deps_remaining(task_id) -> int  (diagnostics / paranoid checks)
 PyObject *engine_deps_remaining(PyObject *obj, PyObject *arg) {
     Engine *self = reinterpret_cast<Engine *>(obj);
@@ -1007,6 +1087,21 @@ PyMethodDef engine_methods[] = {
     {"release_pool", engine_release_pool, METH_VARARGS,
      "release_pool(tile_ids, class_ids): drop a completed pool's slot "
      "payloads and class callbacks"},
+    {"successors", engine_successors, METH_O,
+     "successors(task_id) -> tuple of successor ids (query BEFORE "
+     "complete(); instrumentation mirror for PINS consumers)"},
+    {"trace_enable", engine_trace_enable, METH_VARARGS,
+     "trace_enable(nrings=16, capacity=65536) -> (nrings, cap): arm the "
+     "in-lane event rings (idempotent; see ptrace_ring.h)"},
+    {"trace_disable", engine_trace_disable, METH_NOARGS,
+     "stop recording (rings and drop counters are kept)"},
+    {"trace_drain", engine_trace_drain, METH_NOARGS,
+     "trace_drain() -> [(ring_id, packed_events_bytes)]; event layout "
+     "'<qqII' = (t_ns, id, key, flags)"},
+    {"trace_dropped", engine_trace_dropped, METH_NOARGS,
+     "cumulative events lost to ring overflow (never reset)"},
+    {"monotonic_ns", engine_monotonic_ns, METH_NOARGS,
+     "the trace clock (steady_clock ns) — for epoch calibration"},
     {"deps_remaining", engine_deps_remaining, METH_O,
      "deps_remaining(task_id) -> int"},
     {"pending", engine_pending, METH_NOARGS,
@@ -1237,6 +1332,12 @@ PyMODINIT_FUNC PyInit__ptdtd(void) {
     if (PyModule_AddObject(m, "Engine",
                            reinterpret_cast<PyObject *>(&EngineType)) < 0) {
         Py_DECREF(&EngineType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    if (PyModule_AddIntConstant(m, "EV_LINK", EV_LINK) < 0 ||
+        PyModule_AddIntConstant(m, "EV_EXEC", EV_EXEC) < 0 ||
+        PyModule_AddIntConstant(m, "EV_TASK", EV_TASK) < 0) {
         Py_DECREF(m);
         return nullptr;
     }

@@ -52,7 +52,14 @@
 #include <new>
 #include <vector>
 
+#include "ptrace_ring.h"
+
 namespace {
+
+// in-lane trace event keys (registered in the PBP dictionary by
+// utils/native_trace.py; see ptrace_ring.h for the ring contract)
+constexpr uint32_t EV_TASK = 1;      // one interval per task's retire step
+constexpr uint32_t EV_DISPATCH = 2;  // one interval per batched body dispatch
 
 struct Graph {
     PyObject_HEAD
@@ -78,6 +85,9 @@ struct Graph {
     std::vector<int32_t> *retired;   // fully-consumed slots awaiting Python
     int64_t n_slots;
     int64_t nb_slots_retired;        // total retired (guarded by mu)
+    // in-lane event rings (null until trace_enable; one relaxed check per
+    // run() call when tracing never was enabled)
+    std::atomic<ptrace_ring::State *> trace;
 };
 
 bool parse_i32_list(PyObject *obj, std::vector<int32_t> &out,
@@ -148,6 +158,7 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     self->slot_cnt = nullptr;
     self->use_heap = false;
     self->n_slots = 0;
+    new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
     if (!self->goals || !self->succ_off || !self->succs || !self->seeds ||
         !self->ready || !self->mu || !self->prio || !self->in_off ||
         !self->in_slots || !self->slot_uses || !self->retired) {
@@ -301,6 +312,7 @@ void graph_dealloc(PyObject *obj) {
     delete self->retired;
     delete[] self->counts;
     delete[] self->slot_cnt;
+    delete self->trace.load(std::memory_order_acquire);
     Py_TYPE(obj)->tp_free(obj);
 }
 
@@ -364,6 +376,15 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
     std::vector<int32_t> local, fresh, freed;
     local.reserve((size_t)batch);
     int64_t mine = 0;
+    // in-lane tracing: claim a per-worker ring for this call's duration
+    // (tw.st stays null when tracing is off — one predictable branch per
+    // event site; when tracing is on but every ring is claimed, rec()
+    // counts the lost events into State::unclaimed so the drop accounting
+    // stays honest, see ptrace_ring.h); the destructor releases the claim
+    // on every exit path including a raising callback
+    ptrace_ring::Writer tw;
+    tw.open(self->trace.load(std::memory_order_acquire));
+    const bool tr = tw.st != nullptr;
     PyThreadState *ts = PyEval_SaveThread();   // GIL dropped for the walk
     for (;;) {
         bool stop = false;
@@ -393,6 +414,9 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
         if (callback != Py_None) {
             PyEval_RestoreThread(ts);
             ts = nullptr;
+            if (tr)
+                tw.rec(EV_DISPATCH, (int64_t)local.size(),
+                       ptrace_ring::FLAG_START);
             PyObject *ids = PyList_New((Py_ssize_t)local.size());
             PyObject *r = nullptr;
             if (ids) {
@@ -431,11 +455,15 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                 self->running--;
                 return nullptr;
             }
+            if (tr)
+                tw.rec(EV_DISPATCH, (int64_t)local.size(),
+                       ptrace_ring::FLAG_END);
             ts = PyEval_SaveThread();
         }
         fresh.clear();
         freed.clear();
         for (int32_t t : local) {
+            if (tr) tw.rec(EV_TASK, t, ptrace_ring::FLAG_START);
             for (int32_t k = off[t]; k < off[t + 1]; k++) {
                 int32_t s = succ[k];
                 if (self->counts[s].fetch_sub(
@@ -453,6 +481,7 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                         freed.push_back(j);
                 }
             }
+            if (tr) tw.rec(EV_TASK, t, ptrace_ring::FLAG_END);
         }
         {
             std::lock_guard<std::mutex> lk(*self->mu);
@@ -530,6 +559,34 @@ PyObject *graph_slot_stats(PyObject *obj, PyObject *) {
                          (long long)self->nb_slots_retired);
 }
 
+// ------------------------------------------------------- in-lane tracing
+
+PyObject *graph_trace_enable(PyObject *obj, PyObject *args) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    return ptrace_ring::py_trace_enable(self->trace, args);
+}
+
+PyObject *graph_trace_disable(PyObject *obj, PyObject *) {
+    return ptrace_ring::py_trace_disable(
+        reinterpret_cast<Graph *>(obj)->trace.load(
+            std::memory_order_acquire));
+}
+
+PyObject *graph_trace_drain(PyObject *obj, PyObject *) {
+    return ptrace_ring::py_trace_drain(reinterpret_cast<Graph *>(obj)->trace.load(
+            std::memory_order_acquire));
+}
+
+PyObject *graph_trace_dropped(PyObject *obj, PyObject *) {
+    return ptrace_ring::py_trace_dropped(
+        reinterpret_cast<Graph *>(obj)->trace.load(
+            std::memory_order_acquire));
+}
+
+PyObject *graph_monotonic_ns(PyObject *, PyObject *) {
+    return PyLong_FromLongLong(ptrace_ring::now_ns());
+}
+
 PyMethodDef graph_methods[] = {
     {"run", graph_run, METH_VARARGS,
      "run(callback=None, batch=256, budget=0) -> tasks executed by this call"},
@@ -547,6 +604,18 @@ PyMethodDef graph_methods[] = {
      "(n_tasks, n_edges)"},
     {"slot_stats", graph_slot_stats, METH_NOARGS,
      "(n_slots, n_slots_retired) — the lane-side datarepo retire counters"},
+    {"trace_enable", graph_trace_enable, METH_VARARGS,
+     "trace_enable(nrings=16, capacity=65536) -> (nrings, cap): arm the "
+     "in-lane event rings (idempotent; see ptrace_ring.h)"},
+    {"trace_disable", graph_trace_disable, METH_NOARGS,
+     "stop recording (rings and drop counters are kept)"},
+    {"trace_drain", graph_trace_drain, METH_NOARGS,
+     "trace_drain() -> [(ring_id, packed_events_bytes)]; event layout "
+     "'<qqII' = (t_ns, id, key, flags)"},
+    {"trace_dropped", graph_trace_dropped, METH_NOARGS,
+     "cumulative events lost to ring overflow (never reset)"},
+    {"monotonic_ns", graph_monotonic_ns, METH_NOARGS,
+     "the trace clock (steady_clock ns) — for epoch calibration"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyTypeObject GraphType = [] {
@@ -576,6 +645,11 @@ PyMODINIT_FUNC PyInit__ptexec(void) {
     if (PyModule_AddObject(m, "Graph",
                            reinterpret_cast<PyObject *>(&GraphType)) < 0) {
         Py_DECREF(&GraphType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    if (PyModule_AddIntConstant(m, "EV_TASK", EV_TASK) < 0 ||
+        PyModule_AddIntConstant(m, "EV_DISPATCH", EV_DISPATCH) < 0) {
         Py_DECREF(m);
         return nullptr;
     }

@@ -155,7 +155,16 @@ class Context:
         from ..device.device import DeviceRegistry
         self.devices = DeviceRegistry(self)
         self.comm = None            # set by parsec_tpu.comm when distributed
-        self.profiling = None       # set by utils.trace when enabled
+        #: process tracer: attach one directly (``ctx.profiling =
+        #: Profiling()``) or let ``--mca profile_enabled 1`` create it —
+        #: mca-created tracers dump to ``--mca profile_filename`` at fini
+        #: (the reference's parsec_fini dbp write)
+        self.profiling = None
+        self._prof_auto = False
+        if mca.get("profile_enabled", False):
+            from ..utils.trace import Profiling
+            self.profiling = Profiling()
+            self._prof_auto = True
         self._taskpools: Dict[int, Taskpool] = {}
         self._active = 0
         self._cv = threading.Condition()
@@ -204,8 +213,45 @@ class Context:
         #: engine drain every idle iteration
         self._dtd_neng = None
         self._dtd_batch_pools = 0
+        #: bridge landing the native lanes' in-lane ring events into
+        #: self.profiling (utils/native_trace.py); created lazily when a
+        #: lane arms while profiling is attached — zero cost otherwise
+        self._ntrace = None
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
+
+    # ------------------------------------------------------- in-lane tracing
+    def _native_trace(self):
+        """The native-lane trace bridge, or None when neither profiling
+        (``ctx.profiling``, set by tests/users or --mca profile_enabled)
+        nor PINS instrumentation is active. With PINS but no tracer the
+        bridge runs marker-only (coarse NativeDrainMarker events, nothing
+        landed) so instrumented pools can stay on the native lanes
+        without PINS consumers seeing a silent, idle machine. Lazily
+        constructed and registered as a drain hook so starving progress
+        loops land pending ring events."""
+        prof = self.profiling
+        if prof is not None and not getattr(prof, "enabled", True):
+            prof = None
+        if prof is None and not self.pins.enabled:
+            return None
+        if self._ntrace is None:
+            from ..utils.native_trace import NativeTraceBridge
+            self._ntrace = NativeTraceBridge(prof, self.pins)
+            self.register_drain_hook(self._ntrace.drain_all)
+        elif self._ntrace.prof is None and prof is not None:
+            # a tracer attached after a marker-only bridge armed: upgrade
+            self._ntrace.prof = prof
+        return self._ntrace
+
+    def _ntrace_attach(self, kind: str, obj, tpid: int = 0) -> None:
+        nt = self._native_trace()
+        if nt is not None:
+            nt.attach(kind, obj, tpid)
+
+    def _ntrace_detach(self, obj) -> None:
+        if self._ntrace is not None:
+            self._ntrace.detach(obj)
 
     def register_drain_hook(self, bound_method) -> None:
         import weakref
@@ -339,6 +385,14 @@ class Context:
                 output.warning("fini: drain timed out with work outstanding; "
                                "tearing down anyway")
         self._finalized = True
+        if self._ntrace is not None:
+            # fini: land straggler ring events (blocking final drain)
+            self._ntrace.drain_all(wait=True)
+        if self._prof_auto and self.profiling is not None:
+            try:
+                self.profiling.dump()
+            except OSError as e:
+                output.warning(f"fini: trace dump failed: {e}")
         for s in self.streams:
             if s.nb_executed:
                 output.debug_verbose(1, "stats",
@@ -408,6 +462,9 @@ class Context:
         """A PTG taskpool handed its whole FSM to the native execution
         lane (dsl/ptg/compiler.py _ptexec_prepare); every stream's hot
         loop drains it."""
+        # ring lifecycle (enable): arm in-lane tracing before the first
+        # burst so no lane event predates its rings
+        self._ntrace_attach("ptexec", lane["graph"], tp.taskpool_id)
         with self._ptexec_lock:
             self._ptexec_q.append((tp, lane))
         self._work_event.set()
@@ -473,6 +530,9 @@ class Context:
                     self._ptexec_q.pop(0)
             if fin:
                 tp._ptexec_finalize(lane)
+                # ring lifecycle (quiescence): land the finished graph's
+                # events and stop pinning it
+                self._ntrace_detach(lane["graph"])
             return True
         return mine > 0
 
@@ -517,6 +577,7 @@ class Context:
         would yank inputs out from under a peer still mid-callback;
         leaking instead would pin every produced payload for the
         taskpool's remaining lifetime."""
+        self._ntrace_detach(lane["graph"])   # final drain of an errored lane
         slots = lane.get("slots")
         if not slots:
             return
@@ -716,11 +777,14 @@ class Context:
                        distance: int = 0) -> int:
         """__parsec_task_progress (ref: scheduling.c:507)."""
         tc = task.task_class
-        if getattr(task, "nid", -1) >= 0 and not self.pins.enabled \
+        if getattr(task, "nid", -1) >= 0 and not self.pins.paranoid \
                 and not self.paranoid and tc.fast_inline and not tc.jit_ok:
             # DTD native fast lane: eager CPU body, synchronous completion
-            # — one fused call replaces the prepare/execute/complete FSM
-            # (instrumented runs keep the full cycle for event symmetry)
+            # — one fused call replaces the prepare/execute/complete FSM.
+            # Profiling no longer ejects tasks from this lane (the PR 5
+            # observer-effect removal): with PINS enabled the lean cycle
+            # fires the core lifecycle events itself, and only --mca
+            # pins_paranoid 1 restores the full per-task FSM
             task.taskpool._lean_cycle(stream, task)
             return HOOK_DONE
         if task.status < TASK_STATUS_PREPARE_INPUT:

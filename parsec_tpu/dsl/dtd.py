@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core import pins as pins_mod
 from ..core.context import Context
 from ..core.task import (
     Chore, DEV_ALL, DEV_CPU, DEV_TPU, Flow, FLOW_ACCESS_READ, FLOW_ACCESS_RW,
@@ -76,13 +77,17 @@ mca.register("dtd_batch_insert", True,
 
 #: engagement counters for the batched DTD lane (the DTD analogue of
 #: dsl/ptg/compiler.py PTEXEC_STATS — the ci.sh gate watches ENGAGEMENT,
-#: not throughput). ``tasks_batched`` counts inserts that rode the batch
-#: buffer; ``tasks_per_task`` counts inserts on batch-enabled pools that
-#: fell back to the per-task engine path (first insert of a class, shape
+#: not throughput, through the LaneStats snapshot()/delta() helpers).
+#: ``tasks_batched`` counts inserts that rode the batch buffer;
+#: ``tasks_per_task`` counts inserts on batch-enabled pools that fell
+#: back to the per-task engine path (first insert of a class, shape
 #: mismatch, priority/where/NOTRACK/AFFINITY, jittable bodies with
 #: by-value args); ``pools_batch`` counts pools that enabled the lane.
-PTDTD_STATS = {"pools_batch": 0, "tasks_batched": 0, "tasks_per_task": 0,
-               "batches": 0, "classes_ineligible": 0}
+#: utils/counters.install_native_counters exports these under ``ptdtd.*``
+from ..utils.counters import LaneStats as _LaneStats
+
+PTDTD_STATS = _LaneStats(pools_batch=0, tasks_batched=0, tasks_per_task=0,
+                         batches=0, classes_ineligible=0)
 
 #: "batch registration not yet attempted" marker for the one-entry class
 #: cache (None means attempted-and-ineligible, which must not retry)
@@ -454,11 +459,14 @@ class DTDTaskpool(Taskpool):
             return self._neng
         self._neng_decided = True
         ctx = self.ctx
-        # PINS instrumentation (profilers, the DOT grapher) walks Python
-        # successor lists and paired per-task events — pools first touched
-        # under instrumentation stay on the Python engine
+        # PINS no longer ejects pools from the native engine (PR 5): the
+        # per-task lane keeps firing the full event cycle through the
+        # Python FSM (successor lists mirrored on demand from the engine,
+        # see _complete_execution), the batched lane records in-lane ring
+        # events (utils/native_trace.py). Only --mca pins_paranoid 1
+        # restores the all-Python engine for full-fidelity debugging
         if ctx.comm is not None or ctx.nb_ranks > 1 or self._audit \
-                or ctx.pins.enabled or not mca.get("native_enabled", True):
+                or ctx.pins.paranoid or not mca.get("native_enabled", True):
             return None
         eng = getattr(ctx, "_dtd_neng", None)
         if eng is None and not getattr(ctx, "_dtd_neng_failed", False):
@@ -491,6 +499,12 @@ class DTDTaskpool(Taskpool):
                 self._batch_on = True
                 from .. import native as _nm     # memoized load
                 self._tbuf = _nm.load_ptdtd().try_buffer
+                # ring lifecycle (enable): the batched lane's insert/exec
+                # cycle never surfaces per-task pins events, so its
+                # observability is the in-lane rings (no-op when no
+                # profiling is attached). The engine is per-CONTEXT and
+                # outlives pools, so its events carry taskpool id 0
+                ctx._ntrace_attach("ptdtd", eng)
                 # open-batch-pool count gates the stream hot loops' engine
                 # drain; decremented at final completion so pools running
                 # AFTER this one (e.g. with the batch lane mca-disabled)
@@ -745,6 +759,11 @@ class DTDTaskpool(Taskpool):
         with _BATCH_POOLS_LOCK:
             self.ctx._dtd_batch_pools -= 1
         self._release_native()
+        if self.ctx._ntrace is not None:
+            # ring lifecycle (quiescence): land this pool's in-lane events
+            # now — the engine outlives the pool, but a dumped trace must
+            # not be missing a completed pool's tail
+            self.ctx._ntrace.drain_all(wait=True)
 
     def _release_native(self) -> None:
         """Hand the pool's engine-side references back: tile payload slots
@@ -818,10 +837,31 @@ class DTDTaskpool(Taskpool):
         run, land outputs, retire, release successors — one call from the
         progress loop instead of the generic prepare/execute/complete FSM
         (the machinery a C runtime pays ~0 for; fusing it is how the
-        interpreted runtime stays in the reference's rate class)."""
+        interpreted runtime stays in the reference's rate class).
+
+        Profiling no longer ejects tasks from this lane (PR 5): with PINS
+        enabled the fused cycle fires the core lifecycle events itself —
+        EXEC and COMPLETE/RELEASE pairs plus the engine-successor mirror —
+        so TaskProfiler/ALPerf/grapher consumers keep their contract at
+        near-lean cost; ``--mca pins_paranoid 1`` restores the full FSM
+        (which additionally fires the PREPARE_INPUT pair)."""
         tc = task.task_class
+        pins = self.ctx.pins
+        pins_on = pins.enabled
+        if pins_on:
+            pins.fire(pins_mod.EXEC_BEGIN, stream, task)
         self._run_lean(task, tc, task.tiles, task.arg_spec)
         stream.nb_executed += 1
+        if pins_on:
+            pins.fire(pins_mod.EXEC_END, stream, task)
+            pins.fire(pins_mod.COMPLETE_EXEC_BEGIN, stream, task)
+            # engine-successor mirror for RELEASE consumers (the grapher);
+            # complete() below moves the engine's list out
+            ntasks = self.ctx._dtd_ntasks
+            task.successors = [ntasks[s]
+                               for s in self._neng.successors(task.nid)
+                               if s in ntasks]
+            pins.fire(pins_mod.RELEASE_DEPS_BEGIN, stream, task)
         task.status = TASK_STATUS_COMPLETE
         task.completed = True
         with self._exec_lock:
@@ -834,6 +874,10 @@ class DTDTaskpool(Taskpool):
         task.pending_inputs = None
         if ready_ids:
             self._schedule_native_ready(ready_ids, stream)
+        if pins_on:
+            task.successors = None
+            pins.fire(pins_mod.RELEASE_DEPS_END, stream, task)
+            pins.fire(pins_mod.COMPLETE_EXEC_END, stream, task)
         self.addto_nb_tasks(-1)
 
     def _schedule_native_ready(self, ready_ids, stream=None) -> None:
@@ -1409,6 +1453,17 @@ class DTDTaskpool(Taskpool):
     def _complete_execution(self, stream, task: DTDTask) -> int:
         with self._exec_lock:
             self._executed += 1
+        if task.nid >= 0 and self.ctx.pins.enabled:
+            # instrumentation mirror: the native engine owns the successor
+            # lists, but PINS consumers (the DOT grapher) read
+            # task.successors at RELEASE_DEPS_BEGIN — which fires after
+            # this hook and before _release_deps moves the engine's list.
+            # Only per-task-lane successors have Python task objects;
+            # batch-lane ids stay engine-internal
+            ntasks = self.ctx._dtd_ntasks
+            task.successors = [ntasks[s]
+                               for s in self._neng.successors(task.nid)
+                               if s in ntasks]
         return HOOK_DONE
 
     @property
@@ -1429,6 +1484,7 @@ class DTDTaskpool(Taskpool):
             task.arg_spec = ()
             task.data = ()
             task.pending_inputs = None
+            task.successors = None   # drop the instrumentation mirror
             if ready_ids:
                 self._schedule_native_ready(ready_ids, stream)
             return

@@ -59,14 +59,19 @@ mca.register("ptg_native_exec", True,
              type=bool)
 
 #: lane-engagement accounting (consumed by ci.sh's perf smoke gate and the
-#: bench). ``pools_fallback`` counts pools whose classes were ALL eligible
+#: bench — through the LaneStats snapshot()/delta() helpers, not raw key
+#: pokes). ``pools_fallback`` counts pools whose classes were ALL eligible
 #: yet the lane still declined (flatten refusal, native module missing) —
 #: the silent perf regression no throughput number reliably catches on a
 #: noisy host. ``pools_ineligible`` counts pools declined by DESIGN
-#: (ineligible class features or pool-level gates: distributed/PINS/
-#: paranoid/mca-off) — expected fallbacks, never a CI failure
-PTEXEC_STATS = {"pools_engaged": 0, "tasks_engaged": 0,
-                "pools_fallback": 0, "pools_ineligible": 0}
+#: (ineligible class features or pool-level gates: distributed/
+#: pins-paranoid/debug-paranoid/mca-off) — expected fallbacks, never a CI
+#: failure. utils/counters.install_native_counters exports these under
+#: ``ptexec.*`` for live_view and the SDE-style snapshot
+from ...utils.counters import LaneStats as _LaneStats
+
+PTEXEC_STATS = _LaneStats(pools_engaged=0, tasks_engaged=0,
+                          pools_fallback=0, pools_ineligible=0)
 
 _ACCESS_MAP = {
     P.FLOW_READ: FLOW_ACCESS_READ,
@@ -1119,8 +1124,12 @@ class PTGTaskpool(Taskpool):
         PTEXEC_STATS split the ci.sh gate relies on."""
         ctx = self.ctx
         self._ptexec_refusal = "ineligible"
+        # PINS no longer ejects pools from the lane (PR 5: the lane traces
+        # itself — in-lane ring events land in the PBP streams, see
+        # utils/native_trace.py); only --mca pins_paranoid 1 restores the
+        # full per-task Python instrumentation
         if (not mca.get("ptg_native_exec", True) or ctx.nb_ranks > 1
-                or ctx.comm is not None or ctx.pins.enabled or ctx.paranoid):
+                or ctx.comm is not None or ctx.pins.paranoid or ctx.paranoid):
             return None
         classes = [self._classes[tcs.name]
                    for tcs in self.program.spec.task_classes
@@ -1342,6 +1351,10 @@ class PTGTaskpool(Taskpool):
                              f"{lane['n']} tasks")
         slots = lane.get("slots")
         if slots:
+            # lane-side datarepo accounting into the counter registry
+            # (the slot_stats retire counter, ptexec.slots_retired)
+            from ...utils.counters import PTEXEC_SLOTS_RETIRED, counters
+            counters.add(PTEXEC_SLOTS_RETIRED, lane["graph"].slot_stats()[1])
             slots.clear()
         self.addto_nb_tasks(-lane["n"])
 

@@ -33,6 +33,11 @@ class LiveCounterView:
 
     def __init__(self, registry=None, interval_s: float = 0.1,
                  max_samples: int = 10000) -> None:
+        if registry is None:
+            # default view: make the native lanes visible (ptexec.*,
+            # ptdtd.*, trace.* samplers — idempotent registration)
+            from ..utils.counters import install_native_counters
+            install_native_counters()
         self.registry = registry if registry is not None else default_registry
         self.interval_s = interval_s
         self.max_samples = max_samples

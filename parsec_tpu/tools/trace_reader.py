@@ -19,7 +19,7 @@ import sys
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..utils.trace import MAGIC, parse_info_desc
+from ..utils.trace import EVENT_FLAG_POINT, MAGIC, parse_info_desc
 
 
 @dataclass
@@ -70,12 +70,16 @@ def read_pbp(path: str) -> TraceData:
 
 
 def _intervals(trace: TraceData):
-    """Match begin/end pairs per (stream, base key, event id)."""
+    """Match begin/end pairs per (stream, base key, event id); POINT
+    events (e.g. the native lanes' ``ptdtd::task`` completion marks)
+    yield as zero-duration intervals."""
     for si, stream in enumerate(trace.streams):
         open_ev: Dict[Tuple[int, int], Tuple[float, bytes, int]] = {}
         for key, eid, tpid, t, flags, info in stream["events"]:
             base, is_end = key >> 1, key & 1
-            if not is_end:
+            if flags & EVENT_FLAG_POINT:
+                yield si, stream["name"], base, eid, tpid, t, t, info
+            elif not is_end:
                 open_ev[(base, eid)] = (t, info, tpid)
             else:
                 start = open_ev.pop((base, eid), None)
@@ -113,6 +117,18 @@ def to_chrome_trace(trace: TraceData) -> Dict[str, Any]:
     events = []
     for si, sname, base, eid, tpid, t_s, t_e, info in _intervals(trace):
         d = trace.dictionary[base]
+        if t_e == t_s:          # POINT events render as thread instants
+            events.append({
+                "name": d["name"],
+                "cat": f"taskpool{tpid}",
+                "ph": "i",
+                "s": "t",
+                "ts": (t_s - trace.t0) * 1e6,
+                "pid": 0,
+                "tid": si,
+                "args": {"event_id": eid},
+            })
+            continue
         events.append({
             "name": d["name"],
             "cat": f"taskpool{tpid}",

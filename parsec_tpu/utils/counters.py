@@ -20,6 +20,26 @@ TASKS_ENABLED = "scheduler.tasks_enabled"
 TASKS_RETIRED = "scheduler.tasks_retired"
 
 
+class LaneStats(dict):
+    """Engagement-counter dict for the native lanes (PTEXEC_STATS /
+    PTDTD_STATS) with proper lifecycle helpers, so CI gates and tests
+    stop hand-poking raw keys. Still a plain dict underneath — the hot
+    paths keep their ``stats[key] += 1`` shape."""
+
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        """A point-in-time copy (compare with :meth:`delta`)."""
+        return dict(self)
+
+    def reset(self) -> None:
+        """Zero every counter (bench/test isolation)."""
+        for k in self:
+            self[k] = 0
+
+    def delta(self, since: Dict[str, Union[int, float]]) -> Dict[str, int]:
+        """Per-key change since a :meth:`snapshot`."""
+        return {k: self[k] - since.get(k, 0) for k in self}
+
+
 class CounterRegistry:
     """Process-wide named counters: either atomic accumulators or samplers."""
 
@@ -65,6 +85,36 @@ class CounterRegistry:
 
 
 counters = CounterRegistry()
+
+# canonical native-lane counter names (the SDE-style export of the lane
+# engagement/tracing state; see install_native_counters)
+PTEXEC_POOLS_ENGAGED = "ptexec.pools_engaged"
+PTDTD_TASKS_BATCHED = "ptdtd.tasks_batched"
+TRACE_EVENTS_DROPPED = "trace.events_dropped"
+TRACE_EVENTS_NATIVE = "trace.events_native"
+PTEXEC_SLOTS_RETIRED = "ptexec.slots_retired"
+
+
+def install_native_counters() -> None:
+    """Register the native lanes' engagement stats, the lane-side
+    datarepo retire counter, and the in-lane trace drop/landed counters
+    as samplers under canonical names (``ptexec.*``, ``ptdtd.*``,
+    ``trace.*``) so :mod:`parsec_tpu.tools.live_view` and the SDE-style
+    snapshot export see the lanes. Idempotent."""
+    from ..dsl import dtd as _dtd                # lazy: avoid import cycles
+    from ..dsl.ptg import compiler as _ptg
+    from . import native_trace as _nt
+
+    def _sampler(stats, key):
+        return lambda: stats[key]
+
+    for stats, prefix in ((_ptg.PTEXEC_STATS, "ptexec"),
+                          (_dtd.PTDTD_STATS, "ptdtd")):
+        for key in stats:
+            counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
+    counters.register(TRACE_EVENTS_DROPPED, sampler=_nt.total_dropped)
+    counters.register(TRACE_EVENTS_NATIVE, sampler=_nt.total_landed)
+    counters.register(PTEXEC_SLOTS_RETIRED)   # accumulator: lane finalize adds
 
 
 def install_scheduler_counters(context) -> None:

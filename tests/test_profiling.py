@@ -521,3 +521,330 @@ def test_perf_event_attr_layout():
     (flags,) = struct.unpack_from("Q", raw, 40)
     assert flags & 0x1          # disabled at open
     assert flags & (1 << 5)     # exclude_kernel
+
+
+# --------------------------------------------- native in-lane tracing (PR 5)
+# The observer-effect contract: profiled runs stay on the native lanes and
+# the lanes trace THEMSELVES (per-worker ring buffers drained into the PBP
+# streams, utils/native_trace.py) — the recorded machine is the production
+# machine. --mca pins_paranoid 1 opts back into the per-task Python FSM.
+
+_CHAIN_SRC = (
+    "%global NT\n%global DEPTH\n"
+    "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. DEPTH-1\n"
+    "  CTL S <- (l > 0) ? S T(i, l-1)\n"
+    "        -> (l < DEPTH-1) ? S T(i, l+1)\nBODY\n  pass\nEND\n")
+
+
+def _run_ptg_chain(ctx, nt=16, depth=8, name="ntrace"):
+    prog = compile_ptg(_CHAIN_SRC, name)
+    tp = prog.instantiate(ctx, globals={"NT": nt, "DEPTH": depth},
+                          collections={})
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=60)
+    return tp
+
+
+def test_native_lane_trace_chain(tmp_path):
+    """A profiled chain run stays on the native lane and yields a PBP
+    trace with per-worker native streams: paired START/END task
+    intervals, monotonic per-stream timestamps, zero drops, and a valid
+    chrome://tracing conversion."""
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS
+    ctx = Context(nb_cores=1)
+    ctx.profiling = Profiling()
+    snap = PTEXEC_STATS.snapshot()
+    tp = _run_ptg_chain(ctx)
+    delta = PTEXEC_STATS.delta(snap)
+    ctx.fini()
+    assert tp._ptexec_state is not None, \
+        "profiling ejected the pool from the native lane (observer effect)"
+    assert delta["pools_engaged"] == 1 and delta["pools_fallback"] == 0
+    path = ctx.profiling.dump(str(tmp_path / "native.pbp"))
+    trace = read_pbp(path)
+    assert any(s["name"].startswith("ptexec-w") for s in trace.streams)
+    assert "ptexec::task" in {d["name"] for d in trace.dictionary}
+    for s in trace.streams:           # ring hand-off preserves time order
+        ts = [e[3] for e in s["events"]]
+        assert ts == sorted(ts)
+    df = to_dataframe(trace)
+    tasks = df[df["name"] == "ptexec::task"]
+    assert len(tasks) == 16 * 8       # every lane task: one paired interval
+    assert (tasks["duration"] >= 0).all()
+    ctf = to_chrome_trace(trace)
+    assert len([e for e in ctf["traceEvents"] if e["ph"] == "X"]) == 16 * 8
+    meta = {e["args"]["name"] for e in ctf["traceEvents"] if e["ph"] == "M"}
+    assert any(n.startswith("ptexec-w") for n in meta)
+    assert ctx._ntrace.dropped() == 0
+
+
+def test_profiling_keeps_native_engagement():
+    """Regression for the observer effect: engagement counters of a
+    profiled run match an unprofiled run of the same pool shape."""
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS
+    ctx = Context(nb_cores=1)
+    base = PTEXEC_STATS.snapshot()
+    _run_ptg_chain(ctx, name="plain")
+    plain = PTEXEC_STATS.delta(base)
+    ctx.fini()
+    ctx2 = Context(nb_cores=1)
+    ctx2.profiling = Profiling()
+    base2 = PTEXEC_STATS.snapshot()
+    _run_ptg_chain(ctx2, name="profiled")
+    profiled = PTEXEC_STATS.delta(base2)
+    ctx2.fini()
+    assert profiled == plain, (plain, profiled)
+
+
+def test_native_trace_ring_overflow():
+    """Ring overflow drops events (bumping the drop counter) instead of
+    blocking the lane: the run completes, the drop count is visible, and
+    the drained event count stays within capacity."""
+    from parsec_tpu.utils import mca
+    mca.set("trace_ring_capacity", 32)
+    mca.set("trace_rings", 1)
+    try:
+        ctx = Context(nb_cores=1)
+        ctx.profiling = Profiling()
+        tp = _run_ptg_chain(ctx, nt=64, depth=16, name="overflow")
+        ctx.fini()
+        assert tp._ptexec_state is not None
+        assert tp._ptexec_state["graph"].done()      # lane unharmed
+        assert ctx._ntrace.dropped() > 0
+        st = ctx.profiling.stats()
+        # 2 events per task would be 2048; a 32-slot ring cannot hold them
+        assert st["events"] < 2 * 64 * 16
+    finally:
+        mca.params.unset("trace_ring_capacity")
+        mca.params.unset("trace_rings")
+
+
+def test_pins_paranoid_restores_python_fsm():
+    """--mca pins_paranoid 1 is the full-fidelity escape hatch: an
+    instrumented pool leaves the native lane (pools_ineligible, not
+    fallback) and every task pays the per-task PINS cycle again."""
+    from parsec_tpu.core.pins_modules import ALPerf
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS
+    from parsec_tpu.utils import mca
+    mca.set("pins_paranoid", True)
+    try:
+        ctx = Context(nb_cores=1)
+        al = ALPerf()
+        al.enable(ctx)
+        assert ctx.pins.paranoid
+        snap = PTEXEC_STATS.snapshot()
+        tp = _run_ptg_chain(ctx, nt=4, depth=4, name="paranoid")
+        delta = PTEXEC_STATS.delta(snap)
+        ctx.fini()
+        assert tp._ptexec_state is None
+        assert delta["pools_engaged"] == 0
+        assert delta["pools_ineligible"] == 1
+        assert al.counts["executed"] == 4 * 4     # per-task events are back
+    finally:
+        mca.params.unset("pins_paranoid")
+
+
+def test_dtd_batched_lane_traced(tmp_path):
+    """The DTD batched lane traces its insert->link->exec cycle: link and
+    per-(class, batch) exec intervals plus one completion point per
+    batched task, while engagement matches an unprofiled run."""
+    from parsec_tpu.dsl.dtd import PTDTD_STATS
+
+    def inc(a):
+        return a + 1.0
+
+    ctx = Context(nb_cores=1)
+    ctx.profiling = Profiling()
+    snap = PTDTD_STATS.snapshot()
+    tp = DTDTaskpool(ctx, "dtdtrace")
+    tiles = [tp.tile_new((2, 2), np.float32) for _ in range(4)]
+    for t in tiles:
+        t.data.create_copy(0, np.zeros((2, 2), np.float32))
+    for i in range(256):
+        tp.insert_task(inc, (tiles[i % 4], RW), jit=False)
+    tp.wait(timeout=60)
+    tp.close()
+    ctx.wait(timeout=30)
+    delta = PTDTD_STATS.delta(snap)
+    ctx.fini()
+    assert delta["pools_batch"] == 1, delta      # profiling kept the lane
+    assert delta["tasks_batched"] >= 250, delta
+    for t in tiles:
+        assert float(np.asarray(t.data.newest_copy().payload)[0, 0]) == 64.0
+    path = ctx.profiling.dump(str(tmp_path / "dtd.pbp"))
+    trace = read_pbp(path)
+    kw = {d["name"] for d in trace.dictionary}
+    assert {"ptdtd::link", "ptdtd::exec", "ptdtd::task"} <= kw
+    by_key = {d["key"]: d["name"] for d in trace.dictionary}
+    points = [e for s in trace.streams for e in s["events"]
+              if by_key[e[0] >> 1] == "ptdtd::task"]
+    # one completion point per batched task (per-task-lane inserts ride
+    # the instrumented Python FSM instead)
+    assert len(points) == delta["tasks_batched"]
+    df = to_dataframe(trace)
+    assert (df[df["name"] == "ptdtd::exec"]["duration"] > 0).all()
+    # POINT events surface downstream too: zero-duration dataframe rows
+    # and chrome instant ('i') events, not just raw stream records
+    pts = df[df["name"] == "ptdtd::task"]
+    assert len(pts) == delta["tasks_batched"]
+    assert (pts["duration"] == 0).all()
+    ctf = to_chrome_trace(trace)
+    assert len([e for e in ctf["traceEvents"]
+                if e["ph"] == "i" and e["name"] == "ptdtd::task"]) \
+        == delta["tasks_batched"]
+    assert ctx._ntrace.dropped() == 0
+
+
+def test_native_drain_fires_coarse_pins_markers():
+    """Each drain that lands events fires SCHEDULE_BEGIN/END batch
+    markers so pins_modules consumers observe lane activity without
+    per-task callbacks."""
+    from parsec_tpu.core import pins as P
+    from parsec_tpu.utils.native_trace import NativeDrainMarker
+    ctx = Context(nb_cores=1)
+    ctx.profiling = Profiling()
+    seen = []
+    ctx.pins.register(P.SCHEDULE_END,
+                      lambda s, t, e: seen.append(t)
+                      if isinstance(t, NativeDrainMarker) else None)
+    _run_ptg_chain(ctx, name="markers")
+    ctx.fini()
+    markers = [m for m in seen if m.lane == "ptexec"]
+    assert markers and sum(m.n_events for m in markers) == 2 * 16 * 8
+
+
+def test_lane_stats_helpers():
+    """PTEXEC_STATS/PTDTD_STATS carry snapshot()/reset()/delta() so gates
+    stop hand-poking dict keys."""
+    from parsec_tpu.utils.counters import LaneStats
+    s = LaneStats(a=0, b=0)
+    s["a"] += 3
+    snap = s.snapshot()
+    s["b"] += 2
+    assert s.delta(snap) == {"a": 0, "b": 2}
+    s.reset()
+    assert s == {"a": 0, "b": 0}
+    from parsec_tpu.dsl.dtd import PTDTD_STATS
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS
+    for stats in (PTEXEC_STATS, PTDTD_STATS):
+        assert stats.delta(stats.snapshot()) == {k: 0 for k in stats}
+
+
+def test_native_counters_registry(tmp_path):
+    """install_native_counters exposes the lanes under canonical names
+    (ptexec.*, ptdtd.*, trace.*) for live_view / the SDE-style export."""
+    from parsec_tpu.dsl.dtd import PTDTD_STATS
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS
+    from parsec_tpu.utils.counters import counters, install_native_counters
+    install_native_counters()
+    install_native_counters()       # idempotent
+    snap = counters.snapshot()
+    assert snap["ptexec.pools_engaged"] == PTEXEC_STATS["pools_engaged"]
+    assert snap["ptdtd.tasks_batched"] == PTDTD_STATS["tasks_batched"]
+    assert snap["trace.events_dropped"] >= 0
+    ctx = Context(nb_cores=1)
+    ctx.profiling = Profiling()
+    before = counters.read("ptexec.pools_engaged")
+    _run_ptg_chain(ctx, nt=4, depth=4, name="cntreg")
+    ctx.fini()
+    assert counters.read("ptexec.pools_engaged") == before + 1
+    assert counters.read("trace.events_native") > 0
+
+
+def test_mca_profile_enabled_auto_dump(tmp_path):
+    """--mca profile_enabled 1 attaches a tracer at Context creation and
+    dumps to --mca profile_filename at fini (the reference's parsec_fini
+    dbp write) — with the native lanes traced like an explicit attach."""
+    from parsec_tpu.utils import mca
+    path = str(tmp_path / "auto.pbp")
+    mca.set("profile_enabled", True)
+    mca.set("profile_filename", path)
+    try:
+        ctx = Context(nb_cores=1)
+        assert ctx.profiling is not None
+        tp = _run_ptg_chain(ctx, nt=4, depth=4, name="mcaauto")
+        ctx.fini()
+    finally:
+        mca.params.unset("profile_enabled")
+        mca.params.unset("profile_filename")
+    assert tp._ptexec_state is not None
+    trace = read_pbp(path)
+    assert any(s["name"].startswith("ptexec-w") for s in trace.streams)
+    assert len(to_dataframe(trace)
+               .query("name == 'ptexec::task'")) == 4 * 4
+
+
+def test_pins_only_keeps_lane_and_fires_markers():
+    """PINS instrumentation with NO tracer attached keeps pools on the
+    native lane and runs the bridge marker-only: consumers see coarse,
+    balanced drain markers instead of a silently idle machine."""
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS
+    ctx = Context(nb_cores=1)
+    al = ALPerf()
+    al.enable(ctx)                       # pins.enabled, ctx.profiling None
+    snap = PTEXEC_STATS.snapshot()
+    tp = _run_ptg_chain(ctx, nt=8, depth=4, name="pinsonly")
+    delta = PTEXEC_STATS.delta(snap)
+    ctx.fini()
+    assert tp._ptexec_state is not None, "PINS alone ejected the pool"
+    assert delta["pools_engaged"] == 1 and delta["pools_ineligible"] == 0
+    assert ctx._ntrace is not None and ctx._ntrace.prof is None
+    assert ctx._ntrace.events_landed == 0          # marker-only: no landing
+    assert al.counts["scheduled"] >= 1, "pins consumers saw an idle machine"
+    # SCHEDULE_END and COMPLETE_EXEC_END fire 1:1 per drain — balanced
+    assert al.counts["scheduled"] == al.counts["completed"]
+
+
+def test_drain_markers_keep_scheduler_counters_balanced():
+    """NativeDrainMarker must not drift the canonical enabled/retired
+    counters: every marker SCHEDULE_END has a matching COMPLETE_EXEC_END,
+    so scheduler.pending_tasks returns to its pre-run value."""
+    from parsec_tpu.utils.counters import (
+        TASKS_ENABLED, TASKS_RETIRED, counters, install_scheduler_counters)
+    ctx = Context(nb_cores=1)
+    install_scheduler_counters(ctx)
+    ctx.profiling = Profiling()
+    before = counters.read(TASKS_ENABLED) - counters.read(TASKS_RETIRED)
+    _run_ptg_chain(ctx, nt=8, depth=4, name="balance")
+    ctx.fini()
+    after = counters.read(TASKS_ENABLED) - counters.read(TASKS_RETIRED)
+    assert counters.read(TASKS_ENABLED) > 0        # markers did land
+    assert after == before, "drain markers drifted pending_tasks"
+
+
+def test_trace_accounting_complete_under_ring_contention():
+    """Landed + dropped covers every event the lanes tried to record,
+    even when concurrent engine calls outnumber the rings (the
+    all-rings-claimed case counts into the drop side, never vanishes)."""
+    from parsec_tpu.utils import mca
+    mca.set("trace_rings", 1)            # force worker contention
+    try:
+        ctx = Context(nb_cores=2)
+        ctx.profiling = Profiling()
+        tp = _run_ptg_chain(ctx, nt=64, depth=8, name="contend")
+        ctx.fini()
+        assert tp._ptexec_state is not None
+        # 2 ring events (START/END) per task, no dispatch (CTL bodies):
+        # whatever was not landed must be accounted as dropped
+        total = ctx._ntrace.events_landed + ctx._ntrace.dropped()
+        assert total == 2 * 64 * 8, total
+    finally:
+        mca.params.unset("trace_rings")
+
+
+def test_detach_releases_lane_objects_keeps_drop_count():
+    """detach() must not pin finished graphs (ring storage is freed with
+    the graph) while cumulative drop accounting stays visible."""
+    from parsec_tpu.utils import mca
+    mca.set("trace_ring_capacity", 32)
+    mca.set("trace_rings", 1)
+    try:
+        ctx = Context(nb_cores=1)
+        ctx.profiling = Profiling()
+        _run_ptg_chain(ctx, nt=64, depth=16, name="detach")
+        ctx.fini()
+        assert ctx._ntrace._targets == []          # nothing left attached
+        assert ctx._ntrace.dropped() > 0           # snapshot survived detach
+    finally:
+        mca.params.unset("trace_ring_capacity")
+        mca.params.unset("trace_rings")
