@@ -976,6 +976,58 @@ def main() -> None:
         log(f"reference head-to-head unavailable: {e}")
     persist("after head-to-head")
 
+    # ---- native communication lane: the cross-rank story (ISSUE 7) -------
+    # 2 REAL OS ranks over the TCP mesh, every chain edge crossing ranks.
+    # `_native` = the ptcomm lane (binary activation frames ingested
+    # GIL-free into the execution lane, same-host shm short-circuit);
+    # `_python_comm` = the interpreted remote_dep.py path on the SAME DAG
+    # (the baseline the >=20x acceptance ratio is measured against).
+    try:
+        import functools
+        from benchmarks.comm_lane import chain_program, data_program
+        from parsec_tpu.comm.tcp import run_distributed_procs as _rdp
+        cnt2, cdep2 = 64, 128
+        r_on = _rdp(2, functools.partial(chain_program, nt=cnt2,
+                                         depth=cdep2), timeout=420)
+        assert all(r["engaged"] for r in r_on), "2-rank chain fell off " \
+            "the native comm lane (see ptcomm pools_* counters)"
+        assert all(r["stats"]["frame_errors"] == 0 for r in r_on), \
+            [r["stats"] for r in r_on]
+        r_off = _rdp(2, functools.partial(chain_program, nt=cnt2,
+                                         depth=cdep2, native=False),
+                     timeout=900)
+        native2 = r_on[0]["rate"]
+        python2 = r_off[0]["rate"]
+        results["tasks_per_sec_chain_2rank_native"] = round(native2)
+        results["tasks_per_sec_chain_2rank_python_comm"] = round(python2)
+        results["chain_2rank_native_vs_python_comm"] = \
+            round(native2 / python2, 1) if python2 else None
+        single = results.get("tasks_per_sec_chain") or 0
+        results["chain_2rank_vs_single_rank_native"] = \
+            round(single / native2, 1) if native2 else None
+        d_on = _rdp(2, functools.partial(data_program), timeout=420)
+        assert all(r["engaged"] for r in d_on)
+        results["dataflow_2rank_native"] = round(d_on[0]["rate"])
+        results["comm_lane_note"] = (
+            "2 OS ranks on this host (shm short-circuit engaged), "
+            "alternating-owner chains so EVERY dependency edge crosses "
+            "ranks; rate = global tasks / barrier-aligned wall, median "
+            "of 3. chain_2rank_vs_single_rank_native reports the "
+            "ROADMAP 'within ~5x of single-rank native' gap honestly — "
+            "on this 2-core container both ranks, their comm threads, "
+            "and the spin-polling consumers share two cores, so the "
+            "gap is an upper bound. dataflow_2rank_native moves a 4KB "
+            "f32 tile across ranks at every level (eager frames)")
+        log(f"2-rank comm lane: native {native2:,.0f} tasks/s vs "
+            f"python comm {python2:,.0f} "
+            f"({results['chain_2rank_native_vs_python_comm']}x; "
+            f"single-rank native is "
+            f"{results['chain_2rank_vs_single_rank_native']}x above); "
+            f"dataflow {d_on[0]['rate']:,.0f} tasks/s")
+    except Exception as e:  # noqa: BLE001 — degrade, keep all other keys
+        log(f"2-rank comm lane leg failed: {e}")
+    persist("after comm lane legs")
+
     # per-dispatch protocol cost of this chip path (diagnostic: on the
     # tunneled chip this is ~1000x a local PJRT dispatch and bounds any
     # task-runtime's DAG rate; recorded so the GFLOP/s numbers are readable)

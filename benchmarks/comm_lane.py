@@ -1,0 +1,194 @@
+"""2-rank bench/CI programs for the native communication lane (ptcomm).
+
+Module-level program functions (multiprocessing spawn imports them) used
+by bench.py's `tasks_per_sec_chain_2rank_*` / `dataflow_2rank_*` keys and
+ci.sh's comm-lane engagement gate. Each program runs one rank of a
+2-OS-rank job over the TCP mesh; with the native lane on, cross-rank
+dep-releases ride binary activation frames ingested GIL-free, with it
+off (--mca comm_native 0) the interpreted remote_dep.py path carries the
+same DAG — the honest baseline the ≥20x acceptance ratio is measured
+against.
+"""
+
+import statistics
+import time
+
+#: every chain edge crosses ranks: level l is owned by rank l % 2
+CHAIN_SRC = """%global NT
+%global DEPTH
+%global descA
+T(i, l)
+  i = 0 .. NT-1
+  l = 0 .. DEPTH-1
+  : descA(l, i)
+  CTL S <- (l > 0) ? S T(i, l-1)
+        -> (l < DEPTH-1) ? S T(i, l+1)
+BODY
+  pass
+END
+"""
+
+#: same shape with a DATA flow: the tile payload hops ranks every level
+DATA_SRC = """%global NT
+%global DEPTH
+%global TS
+%global descA
+%global descX
+%global descY
+T(i, l)
+  i = 0 .. NT-1
+  l = 0 .. DEPTH-1
+  : descA(l, i)
+  RW X <- (l == 0) ? descX(0, i) : X T(i, l-1)
+       -> (l < DEPTH-1) ? X T(i, l+1) : descY(0, i)
+BODY
+  pass
+END
+"""
+
+
+def _force_cpu():
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _setup(rank, ce, native):
+    _force_cpu()
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.utils import mca
+    if not native:
+        mca.set("comm_native", False)
+    ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=ce.nb_ranks)
+    RemoteDepEngine(ctx, ce)
+    return ctx
+
+
+def _finish(rank, ce, ctx, tp, rates, extra=None):
+    engaged = tp._ptexec_state is not None and \
+        tp._ptexec_state.get("pool_id") is not None
+    stats = None
+    if ctx.comm.native is not None:
+        s = ctx.comm.native.comm.stats()
+        stats = {k: (list(v) if isinstance(v, list) else v)
+                 for k, v in s.items()}
+    from parsec_tpu.comm.native import PTCOMM_STATS
+    out = {"rank": rank, "rates": rates,
+           "rate": statistics.median(rates) if rates else 0.0,
+           "engaged": engaged, "stats": stats,
+           "lane_stats": PTCOMM_STATS.snapshot()}
+    if extra:
+        out.update(extra)
+    ce.sync()
+    ctx.fini()
+    ce.fini()
+    return out
+
+
+def chain_program(rank, ce, nt=64, depth=128, native=True, reps=3):
+    """Cross-rank CTL chains: NT independent chains of DEPTH levels,
+    alternating ranks every level. Rate = global tasks / barrier-aligned
+    wall, median of ``reps`` after one warm rep."""
+    ctx = _setup(rank, ce, native)
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    A = TwoDimBlockCyclic("descA", depth, nt, 1, 1, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    prog = compile_ptg(CHAIN_SRC, "bench-comm-chain")
+    rates = []
+    tp = None
+    for r in range(reps + 1):
+        ce.sync()
+        t0 = time.perf_counter()
+        tp = prog.instantiate(ctx, globals={"NT": nt, "DEPTH": depth},
+                              collections={"descA": A},
+                              name=f"bench-comm-chain-{r}")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=300)
+        ce.sync()                      # both ranks done: global wall
+        if r:
+            rates.append(nt * depth / (time.perf_counter() - t0))
+    return _finish(rank, ce, ctx, tp, rates)
+
+
+def data_program(rank, ce, nt=16, depth=32, ts=32, native=True, reps=3):
+    """Cross-rank DATA chains: a TS x TS f32 tile payload hops ranks at
+    every level (eager under the default limit)."""
+    import numpy as np
+    ctx = _setup(rank, ce, native)
+    from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    A = TwoDimBlockCyclic("descA", depth, nt, 1, 1, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    X = TiledMatrix("descX", ts, nt * ts, ts, ts)
+    X.fill(lambda m, i: np.full((ts, ts), float(i + 1), np.float32))
+    Y = TiledMatrix("descY", ts, nt * ts, ts, ts)
+    prog = compile_ptg(DATA_SRC, "bench-comm-data")
+    rates = []
+    tp = None
+    for r in range(reps + 1):
+        ce.sync()
+        t0 = time.perf_counter()
+        tp = prog.instantiate(ctx, globals={"NT": nt, "DEPTH": depth,
+                                            "TS": ts},
+                              collections={"descA": A, "descX": X,
+                                           "descY": Y},
+                              name=f"bench-comm-data-{r}")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=300)
+        ce.sync()
+        if r:
+            rates.append(nt * depth / (time.perf_counter() - t0))
+    # correctness canary: the terminal write-back landed on the owner of
+    # T(i, DEPTH-1) with the forwarded (unchanged) seed value
+    checked = 0
+    if (depth - 1) % 2 == rank:
+        for i in range(nt):
+            d = Y.data_of(0, i)
+            c = d.get_copy(0)
+            assert c is not None and d.version > 0, "write-back missing"
+            assert float(np.asarray(c.payload)[0, 0]) == float(i + 1)
+            checked += 1
+    return _finish(rank, ce, ctx, tp, rates, {"checked": checked})
+
+
+def ci_gate(nt: int = 8, depth: int = 8) -> None:
+    """The ci.sh comm-lane engagement gate: a 2-OS-rank chain whose every
+    edge crosses ranks must ride the native lane (activation frames
+    counted on both ends, pools engaged, ZERO frame errors), never
+    silently fall back to the interpreted remote_dep path."""
+    import functools
+    from parsec_tpu.comm.tcp import run_distributed_procs
+
+    res = run_distributed_procs(
+        2, functools.partial(chain_program, nt=nt, depth=depth, reps=1),
+        timeout=180)
+    for rank, r in enumerate(res):
+        assert r["engaged"], \
+            f"rank {rank}: pool fell off the native comm lane"
+        ls = r["lane_stats"]
+        assert ls["lanes_up"] >= 1 and ls["pools_engaged"] >= 1, ls
+        assert ls["pools_fallback"] == 0, ls
+        s = r["stats"]
+        assert s["acts_tx"] > 0 and s["acts_rx"] > 0, s
+        assert s["frame_errors"] == 0 and s["dropped_sends"] == 0, s
+        assert s["broken_peers"] == [], s
+        assert s["payloads_pending"] == 0, s
+    total_edges = nt * (depth - 1) * 2     # warm rep + 1 measured rep
+    got = sum(r["stats"]["acts_rx"] for r in res)
+    assert got == total_edges, \
+        f"activations {got} != cross edges {total_edges}"
+    print(f"comm lane engagement OK: {got} cross-rank activations, "
+          f"0 frame errors, 0 fallbacks")
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if "--ci-gate" in sys.argv:
+        ci_gate()

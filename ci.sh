@@ -17,7 +17,8 @@ from parsec_tpu import native
 assert native.available(), "libptcore.so built but failed to load"
 assert native.load_ptdtd() is not None, "_ptdtd built but failed to load"
 assert native.load_ptexec() is not None, "_ptexec built but failed to load"
-print("native artifacts OK (ptcore, ptdtd, ptexec)")
+assert native.load_ptcomm() is not None, "_ptcomm built but failed to load"
+print("native artifacts OK (ptcore, ptdtd, ptexec, ptcomm)")
 EOF
 
 echo "== no compiled artifacts tracked/staged =="
@@ -103,6 +104,15 @@ for t in tiles:
 ctx.fini()
 print(f"DTD batched lane engagement OK: {delta}")
 EOF
+
+echo "== native comm lane engagement smoke (2 ranks) =="
+# same contract as the execution-lane gates: assert ENGAGEMENT, not
+# throughput — a 2-OS-rank chain whose every edge crosses ranks must ride
+# the native comm lane (activation frames counted on both ends, pools
+# registered, ZERO frame errors), not silently fall back to the
+# interpreted remote_dep path. Lives in a FILE (not a heredoc): the
+# spawned ranks re-import the main module, which stdin cannot provide.
+JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/comm_lane.py --ci-gate
 
 echo "== traced native-lane smoke (observer-effect gate) =="
 # profiling must NOT eject pools from the native lanes (PR 5): a traced

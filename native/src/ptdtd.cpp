@@ -56,10 +56,12 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <mutex>
 #include <new>
 #include <vector>
 
+#include "ptcomm_iface.h"
 #include "ptrace_ring.h"
 
 namespace {
@@ -116,6 +118,12 @@ struct Engine {
     int64_t live;                 // inserted - completed
     int64_t batch_done;           // batch-lane tasks executed (diagnostics)
     bool poisoned;                // a batch callback raised
+    // remote-ingest surfacing (the comm lane's ptdtd entry point): ready
+    // PER-TASK-LANE tasks released by an arrived remote dep park here
+    // until the next drain_ready() hands them to Python for scheduling
+    std::vector<int64_t> *rsurf;
+    std::atomic<int64_t> acts_rx;      // remote decrements ingested
+    std::atomic<int64_t> ingest_bad;   // out-of-range/completed ids
     // in-lane event rings (null until trace_enable)
     std::atomic<ptrace_ring::State *> trace;
 };
@@ -130,13 +138,17 @@ PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
     self->flow_tile = new (std::nothrow) std::vector<int64_t>();
     self->flow_acc = new (std::nothrow) std::vector<int64_t>();
     self->ready = new (std::nothrow) std::vector<int64_t>();
+    self->rsurf = new (std::nothrow) std::vector<int64_t>();
     self->stamp = 0;
     self->live = 0;
     self->batch_done = 0;
     self->poisoned = false;
+    new (&self->acts_rx) std::atomic<int64_t>(0);
+    new (&self->ingest_bad) std::atomic<int64_t>(0);
     new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
     if (!self->mu || !self->tasks || !self->tiles || !self->classes ||
-        !self->flow_tile || !self->flow_acc || !self->ready) {
+        !self->flow_tile || !self->flow_acc || !self->ready ||
+        !self->rsurf) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -162,6 +174,7 @@ void engine_dealloc(PyObject *obj) {
     delete self->flow_tile;
     delete self->flow_acc;
     delete self->ready;
+    delete self->rsurf;
     delete self->trace.load(std::memory_order_acquire);
     Py_TYPE(obj)->tp_free(obj);
 }
@@ -807,6 +820,17 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
         }
         if (budget > 0 && total >= budget) break;
     }
+    {
+        // hand over per-task-lane tasks a remote ingest released since
+        // the last drain (ingest_act runs on the comm progress thread
+        // and cannot schedule Python tasks itself)
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (!self->rsurf->empty()) {
+            surfaced.insert(surfaced.end(), self->rsurf->begin(),
+                            self->rsurf->end());
+            self->rsurf->clear();
+        }
+    }
     PyObject *sur = PyTuple_New((Py_ssize_t)surfaced.size());
     if (!sur) return nullptr;
     for (size_t i = 0; i < surfaced.size(); i++) {
@@ -1058,6 +1082,75 @@ PyObject *engine_sizes(PyObject *obj, PyObject *) {
                          (Py_ssize_t)self->tiles->size());
 }
 
+// ------------------------------------------------------- comm lane ingest
+
+// GIL-free entry the comm progress thread calls through the
+// PtCommIngestVtbl capsule: one arrived remote dep-release for task
+// `tid`. A newly-ready batch-lane task joins the internal ready
+// structure (next drain_ready executes it); a per-task-lane task parks
+// in `rsurf` until drain_ready surfaces it for Python scheduling.
+void dtd_ingest_act_c(void *obj, int32_t tid) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (tid < 0 || (size_t)tid >= self->tasks->size()) {
+        self->ingest_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    TaskRec &rec = (*self->tasks)[(size_t)tid];
+    if (rec.completed) {
+        self->ingest_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    self->acts_rx.fetch_add(1, std::memory_order_relaxed);
+    if (--rec.deps_remaining == 0) {
+        if (rec.cls >= 0)
+            self->ready->push_back(tid);
+        else
+            self->rsurf->push_back(tid);
+    }
+}
+
+void dtd_ingest_capsule_free(PyObject *cap) {
+    std::free(PyCapsule_GetPointer(cap, PTCOMM_INGEST_CAPSULE));
+}
+
+PyObject *engine_ingest_capsule(PyObject *obj, PyObject *) {
+    PtCommIngestVtbl *v =
+        static_cast<PtCommIngestVtbl *>(std::malloc(sizeof(PtCommIngestVtbl)));
+    if (!v) return PyErr_NoMemory();
+    v->abi = PTCOMM_ABI;
+    v->obj = obj;
+    v->act = dtd_ingest_act_c;
+    v->rdv_begin = nullptr;   // DTD payloads land through the tile/slot
+    v->rdv_land = nullptr;    // machinery, not per-slot gates
+    PyObject *cap = PyCapsule_New(v, PTCOMM_INGEST_CAPSULE,
+                                  dtd_ingest_capsule_free);
+    if (!cap) std::free(v);
+    return cap;
+}
+
+PyObject *engine_ingest(PyObject *obj, PyObject *arg) {
+    long long tid = PyLong_AsLongLong(arg);
+    if (tid == -1 && PyErr_Occurred()) return nullptr;
+    dtd_ingest_act_c(obj, (int32_t)tid);
+    Py_RETURN_NONE;
+}
+
+PyObject *engine_comm_stats(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    long long rs;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        rs = (long long)self->rsurf->size();
+    }
+    return Py_BuildValue(
+        "{s:L,s:L,s:L}",
+        "acts_rx", (long long)self->acts_rx.load(std::memory_order_relaxed),
+        "ingest_bad",
+        (long long)self->ingest_bad.load(std::memory_order_relaxed),
+        "rsurf_pending", rs);
+}
+
 PyMethodDef engine_methods[] = {
     {"tile", engine_tile, METH_NOARGS,
      "register a tile chain; returns its id"},
@@ -1112,6 +1205,12 @@ PyMethodDef engine_methods[] = {
      "total batch-lane tasks executed by drain_ready"},
     {"sizes", engine_sizes, METH_NOARGS,
      "(total tasks ever, total tiles) — memory diagnostics"},
+    {"ingest", engine_ingest, METH_O,
+     "ingest(tid): one remote dep-release arrived for task tid"},
+    {"ingest_capsule", engine_ingest_capsule, METH_NOARGS,
+     "PyCapsule(PtCommIngestVtbl) for Comm.register_pool (GIL-free ingest)"},
+    {"comm_stats", engine_comm_stats, METH_NOARGS,
+     "{acts_rx, ingest_bad, rsurf_pending}"},
     {nullptr, nullptr, 0, nullptr}};
 
 // ----------------------------------------------------- insert fast path

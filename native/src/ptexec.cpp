@@ -48,10 +48,12 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <mutex>
 #include <new>
 #include <vector>
 
+#include "ptcomm_iface.h"
 #include "ptrace_ring.h"
 
 namespace {
@@ -88,6 +90,24 @@ struct Graph {
     // in-lane event rings (null until trace_enable; one relaxed check per
     // run() call when tracing never was enabled)
     std::atomic<ptrace_ring::State *> trace;
+    // distributed mode (comm_bind): per-task owner ranks; edges into a
+    // non-local successor surface as activation frames on the comm lane's
+    // send queue instead of local decrements, and ingest_act() lets the
+    // comm progress thread drop arrived decrements straight into the
+    // ready structure — both directions GIL-free (ptcomm_iface.h)
+    std::vector<int32_t> *owners;     // empty = single-rank graph
+    int32_t my_rank;
+    uint32_t pool_id;
+    bool comm_bound;
+    PtCommSendVtbl send;
+    int64_t n_local;                  // tasks this rank executes
+    // rendezvous gates: a slot whose payload is still being pulled parks
+    // would-be-ready consumers until rdv_land() (guarded by mu)
+    std::vector<uint8_t> *rdv_pending;  // per input slot, 1 = pulling
+    std::vector<int32_t> *parked;       // ready tasks waiting on a pull
+    std::atomic<int64_t> acts_tx;       // remote releases surfaced
+    std::atomic<int64_t> acts_rx;       // remote decrements ingested
+    std::atomic<int64_t> ingest_bad;    // out-of-range ids from the wire
 };
 
 bool parse_i32_list(PyObject *obj, std::vector<int32_t> &out,
@@ -116,6 +136,44 @@ struct PrioLess {
     }
 };
 
+// mu held. True when any of task `t`'s input slots is mid-rendezvous.
+bool slots_pending_locked(Graph *g, int32_t t) {
+    if (g->rdv_pending->empty() || g->in_off->empty()) return false;
+    const int32_t *ioff = g->in_off->data();
+    const int32_t *islot = g->in_slots->data();
+    const uint8_t *pend = g->rdv_pending->data();
+    for (int32_t k = ioff[t]; k < ioff[t + 1]; k++)
+        if (pend[islot[k]]) return true;
+    return false;
+}
+
+// mu held. Enter the ready structure (heap-aware) unless an input slot's
+// rendezvous is still in flight — then park until rdv_land().
+void push_ready_locked(Graph *g, int32_t s) {
+    if (g->comm_bound && slots_pending_locked(g, s)) {
+        g->parked->push_back(s);
+        return;
+    }
+    g->ready->push_back(s);
+    if (g->use_heap)
+        std::push_heap(g->ready->begin(), g->ready->end(),
+                       PrioLess{g->prio->data()});
+}
+
+// recompute the seed list: with owners bound, only LOCAL zero-goal tasks
+// may ever enter the ready structure (remote tasks run on their rank)
+void graph_rebuild_seeds(Graph *self) {
+    self->seeds->clear();
+    self->n_local = 0;
+    const bool bound = self->comm_bound;
+    for (int64_t i = 0; i < self->n; i++) {
+        if (bound && (*self->owners)[(size_t)i] != self->my_rank) continue;
+        self->n_local++;
+        if ((*self->goals)[(size_t)i] == 0)
+            self->seeds->push_back((int32_t)i);
+    }
+}
+
 void graph_reset_state(Graph *self) {
     for (int64_t i = 0; i < self->n; i++)
         self->counts[i].store((*self->goals)[(size_t)i],
@@ -124,6 +182,9 @@ void graph_reset_state(Graph *self) {
     if (self->use_heap)
         std::make_heap(self->ready->begin(), self->ready->end(),
                        PrioLess{self->prio->data()});
+    std::fill(self->rdv_pending->begin(), self->rdv_pending->end(),
+              (uint8_t)0);
+    self->parked->clear();
     for (int64_t j = 0; j < self->n_slots; j++)
         self->slot_cnt[j].store((*self->slot_uses)[(size_t)j],
                                 std::memory_order_relaxed);
@@ -159,9 +220,21 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     self->use_heap = false;
     self->n_slots = 0;
     new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
+    self->owners = new (std::nothrow) std::vector<int32_t>();
+    self->rdv_pending = new (std::nothrow) std::vector<uint8_t>();
+    self->parked = new (std::nothrow) std::vector<int32_t>();
+    self->my_rank = 0;
+    self->pool_id = 0;
+    self->comm_bound = false;
+    self->send = PtCommSendVtbl{0, nullptr, nullptr};
+    self->n_local = 0;
+    new (&self->acts_tx) std::atomic<int64_t>(0);
+    new (&self->acts_rx) std::atomic<int64_t>(0);
+    new (&self->ingest_bad) std::atomic<int64_t>(0);
     if (!self->goals || !self->succ_off || !self->succs || !self->seeds ||
         !self->ready || !self->mu || !self->prio || !self->in_off ||
-        !self->in_slots || !self->slot_uses || !self->retired) {
+        !self->in_slots || !self->slot_uses || !self->retired ||
+        !self->owners || !self->rdv_pending || !self->parked) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -272,14 +345,15 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
         }
     }
     for (int64_t i = 0; i < self->n; i++) {
-        int32_t g = (*self->goals)[(size_t)i];
-        if (g < 0) {
+        if ((*self->goals)[(size_t)i] < 0) {
             PyErr_SetString(PyExc_ValueError, "negative goal");
             Py_DECREF(self);
             return nullptr;
         }
-        if (g == 0) self->seeds->push_back((int32_t)i);
     }
+    graph_rebuild_seeds(self);
+    if (self->n_slots)
+        self->rdv_pending->assign((size_t)self->n_slots, 0);
     self->counts = new (std::nothrow) std::atomic<int32_t>[(size_t)self->n];
     if (self->n && !self->counts) {
         Py_DECREF(self);
@@ -310,6 +384,9 @@ void graph_dealloc(PyObject *obj) {
     delete self->in_slots;
     delete self->slot_uses;
     delete self->retired;
+    delete self->owners;
+    delete self->rdv_pending;
+    delete self->parked;
     delete[] self->counts;
     delete[] self->slot_cnt;
     delete self->trace.load(std::memory_order_acquire);
@@ -462,10 +539,23 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
         }
         fresh.clear();
         freed.clear();
+        const bool bound = self->comm_bound;
+        const int32_t *own = bound ? self->owners->data() : nullptr;
+        int64_t sent = 0;
         for (int32_t t : local) {
             if (tr) tw.rec(EV_TASK, t, ptrace_ring::FLAG_START);
             for (int32_t k = off[t]; k < off[t + 1]; k++) {
                 int32_t s = succ[k];
+                if (bound && own[s] != self->my_rank) {
+                    // remote successor: the dep-release crosses ranks as
+                    // an activation frame — enqueue onto the comm lane's
+                    // lock-free send queue, still GIL-free (the funneled
+                    // progress thread does the wire work)
+                    self->send.send_act(self->send.comm, own[s],
+                                        self->pool_id, s);
+                    sent++;
+                    continue;
+                }
                 if (self->counts[s].fetch_sub(
                         1, std::memory_order_acq_rel) == 1)
                     fresh.push_back(s);
@@ -483,12 +573,17 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             }
             if (tr) tw.rec(EV_TASK, t, ptrace_ring::FLAG_END);
         }
+        if (sent)
+            self->acts_tx.fetch_add(sent, std::memory_order_relaxed);
         {
             std::lock_guard<std::mutex> lk(*self->mu);
             self->completed += (int64_t)local.size();
             self->running--;
             if (!fresh.empty()) {
-                if (self->use_heap) {
+                if (bound && !self->in_off->empty()) {
+                    // distributed data pool: gate on in-flight rendezvous
+                    for (int32_t s : fresh) push_ready_locked(self, s);
+                } else if (self->use_heap) {
                     for (int32_t s : fresh) {
                         self->ready->push_back(s);
                         std::push_heap(self->ready->begin(),
@@ -516,7 +611,7 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
 PyObject *graph_done(PyObject *obj, PyObject *) {
     Graph *self = reinterpret_cast<Graph *>(obj);
     std::lock_guard<std::mutex> lk(*self->mu);
-    if (!self->error && self->completed == self->n &&
+    if (!self->error && self->completed == self->n_local &&
         self->ready->empty() && self->running == 0)
         Py_RETURN_TRUE;
     Py_RETURN_FALSE;
@@ -543,7 +638,172 @@ PyObject *graph_idle(PyObject *obj, PyObject *) {
 PyObject *graph_pending(PyObject *obj, PyObject *) {
     Graph *self = reinterpret_cast<Graph *>(obj);
     std::lock_guard<std::mutex> lk(*self->mu);
-    return PyLong_FromLongLong(self->n - self->completed);
+    return PyLong_FromLongLong(self->n_local - self->completed);
+}
+
+// ------------------------------------------------------- comm lane binding
+
+// The GIL-free entry points the comm progress thread calls through the
+// PtCommIngestVtbl capsule (ptcomm_iface.h). Out-of-range ids from the
+// wire are counted, never trusted.
+void graph_ingest_act_c(void *obj, int32_t tid) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    if (tid < 0 || (int64_t)tid >= self->n ||
+        (self->comm_bound &&
+         (*self->owners)[(size_t)tid] != self->my_rank)) {
+        // in-range but REMOTE-owned ids are just as untrusted as
+        // out-of-range ones: decrementing them could locally execute a
+        // task this rank does not own and wedge done() accounting
+        self->ingest_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    self->acts_rx.fetch_add(1, std::memory_order_relaxed);
+    if (self->counts[tid].fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        push_ready_locked(self, tid);
+    }
+}
+
+void graph_rdv_begin_c(void *obj, int32_t slot) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (slot < 0 || (int64_t)slot >= self->n_slots) {
+        self->ingest_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    (*self->rdv_pending)[(size_t)slot] = 1;
+}
+
+void graph_rdv_land_c(void *obj, int32_t slot) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (slot < 0 || (int64_t)slot >= self->n_slots) {
+        self->ingest_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    (*self->rdv_pending)[(size_t)slot] = 0;
+    if (self->parked->empty()) return;
+    // re-examine parked consumers: any with no remaining in-flight pulls
+    // becomes ready (others stay parked for their other slots)
+    size_t w = 0;
+    std::vector<int32_t> &pk = *self->parked;
+    for (size_t i = 0; i < pk.size(); i++) {
+        int32_t t = pk[i];
+        if (slots_pending_locked(self, t)) {
+            pk[w++] = t;
+        } else {
+            self->ready->push_back(t);
+            if (self->use_heap)
+                std::push_heap(self->ready->begin(), self->ready->end(),
+                               PrioLess{self->prio->data()});
+        }
+    }
+    pk.resize(w);
+}
+
+void ingest_capsule_free(PyObject *cap) {
+    std::free(PyCapsule_GetPointer(cap, PTCOMM_INGEST_CAPSULE));
+}
+
+// ingest_capsule() -> PyCapsule(PtCommIngestVtbl) for Comm.register_pool.
+// The capsule borrows `self`: the Python comm lane holds a strong ref to
+// the graph for the registration window (ptcomm_iface.h lifetime rules).
+PyObject *graph_ingest_capsule(PyObject *obj, PyObject *) {
+    PtCommIngestVtbl *v =
+        static_cast<PtCommIngestVtbl *>(std::malloc(sizeof(PtCommIngestVtbl)));
+    if (!v) return PyErr_NoMemory();
+    v->abi = PTCOMM_ABI;
+    v->obj = obj;
+    v->act = graph_ingest_act_c;
+    v->rdv_begin = graph_rdv_begin_c;
+    v->rdv_land = graph_rdv_land_c;
+    PyObject *cap = PyCapsule_New(v, PTCOMM_INGEST_CAPSULE,
+                                  ingest_capsule_free);
+    if (!cap) std::free(v);
+    return cap;
+}
+
+// comm_bind(send_capsule, pool_id, my_rank, owners) — enter distributed
+// mode: `owners[i]` names the rank executing task i; local release sweeps
+// surface non-local successors through the send vtable. Must be called
+// before any run() (the seed list is rebuilt rank-local).
+PyObject *graph_comm_bind(PyObject *obj, PyObject *args) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    PyObject *cap, *owners_o;
+    unsigned int pool;
+    int my_rank;
+    if (!PyArg_ParseTuple(args, "OIiO", &cap, &pool, &my_rank, &owners_o))
+        return nullptr;
+    PtCommSendVtbl *sv = static_cast<PtCommSendVtbl *>(
+        PyCapsule_GetPointer(cap, PTCOMM_SEND_CAPSULE));
+    if (!sv) return nullptr;
+    if (sv->abi != PTCOMM_ABI) {
+        PyErr_SetString(PyExc_RuntimeError, "ptcomm ABI mismatch");
+        return nullptr;
+    }
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (self->running > 0 || self->completed > 0) {
+            PyErr_SetString(PyExc_RuntimeError,
+                            "comm_bind() on a graph already running");
+            return nullptr;
+        }
+    }
+    std::vector<int32_t> owners;
+    if (!parse_i32_list(owners_o, owners, "owners: sequence of ints"))
+        return nullptr;
+    if ((int64_t)owners.size() != self->n) {
+        PyErr_SetString(PyExc_ValueError, "owners must have n entries");
+        return nullptr;
+    }
+    *self->owners = std::move(owners);
+    self->send = *sv;
+    self->pool_id = pool;
+    self->my_rank = my_rank;
+    self->comm_bound = true;
+    if (!self->rdv_pending->size() && self->n_slots)
+        self->rdv_pending->assign((size_t)self->n_slots, 0);
+    graph_rebuild_seeds(self);
+    graph_reset_state(self);
+    return Py_BuildValue("L", (long long)self->n_local);
+}
+
+// Python-side mirrors of the C ingest entries (tests + non-native drivers)
+PyObject *graph_ingest(PyObject *obj, PyObject *arg) {
+    long tid = PyLong_AsLong(arg);
+    if (tid == -1 && PyErr_Occurred()) return nullptr;
+    graph_ingest_act_c(obj, (int32_t)tid);
+    Py_RETURN_NONE;
+}
+
+PyObject *graph_rdv_begin(PyObject *obj, PyObject *arg) {
+    long slot = PyLong_AsLong(arg);
+    if (slot == -1 && PyErr_Occurred()) return nullptr;
+    graph_rdv_begin_c(obj, (int32_t)slot);
+    Py_RETURN_NONE;
+}
+
+PyObject *graph_rdv_land(PyObject *obj, PyObject *arg) {
+    long slot = PyLong_AsLong(arg);
+    if (slot == -1 && PyErr_Occurred()) return nullptr;
+    graph_rdv_land_c(obj, (int32_t)slot);
+    Py_RETURN_NONE;
+}
+
+PyObject *graph_comm_stats(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    int64_t parked;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        parked = (int64_t)self->parked->size();
+    }
+    return Py_BuildValue(
+        "{s:L,s:L,s:L,s:L,s:L}",
+        "acts_tx", (long long)self->acts_tx.load(std::memory_order_relaxed),
+        "acts_rx", (long long)self->acts_rx.load(std::memory_order_relaxed),
+        "ingest_bad",
+        (long long)self->ingest_bad.load(std::memory_order_relaxed),
+        "n_local", (long long)self->n_local, "parked", (long long)parked);
 }
 
 PyObject *graph_size(PyObject *obj, PyObject *) {
@@ -604,6 +864,19 @@ PyMethodDef graph_methods[] = {
      "(n_tasks, n_edges)"},
     {"slot_stats", graph_slot_stats, METH_NOARGS,
      "(n_slots, n_slots_retired) — the lane-side datarepo retire counters"},
+    {"comm_bind", graph_comm_bind, METH_VARARGS,
+     "comm_bind(send_capsule, pool_id, my_rank, owners) -> n_local: enter "
+     "distributed mode (remote successors surface on the comm lane)"},
+    {"ingest_capsule", graph_ingest_capsule, METH_NOARGS,
+     "PyCapsule(PtCommIngestVtbl) for Comm.register_pool (GIL-free ingest)"},
+    {"ingest", graph_ingest, METH_O,
+     "ingest(tid): one remote dep-release arrived for task tid"},
+    {"rdv_begin", graph_rdv_begin, METH_O,
+     "rdv_begin(slot): gate consumers of slot until its pull lands"},
+    {"rdv_land", graph_rdv_land, METH_O,
+     "rdv_land(slot): pull landed; release parked consumers"},
+    {"comm_stats", graph_comm_stats, METH_NOARGS,
+     "{acts_tx, acts_rx, ingest_bad, n_local, parked}"},
     {"trace_enable", graph_trace_enable, METH_VARARGS,
      "trace_enable(nrings=16, capacity=65536) -> (nrings, cap): arm the "
      "in-lane event rings (idempotent; see ptrace_ring.h)"},

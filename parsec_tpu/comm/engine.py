@@ -32,6 +32,7 @@ TAG_INTERNAL_PUT = 1
 TAG_REMOTE_DEP_ACTIVATE = 2
 TAG_TERMDET = 3
 TAG_DSL_BASE = 4          # TTG-style DSL reservations start here
+TAG_PTCOMM_BOOT = 8       # native comm lane bootstrap (comm/native.py)
 TAG_CNT_AGG = 10          # cross-rank counter aggregation at fini
 TAG_DTD_AUDIT = 11        # DTD replay-consistency auditor exchange
 
@@ -132,13 +133,59 @@ class CommEngine:
         pass
 
     # --- pack/unpack --------------------------------------------------------
+    #: prefix marking a raw-bytes packed blob: no pickle frame at all.
+    #: Pickle streams (protocol >= 2) always begin with b"\x80", so the
+    #: NUL-led magic can never collide with a pickled message.
+    _RAW_MAGIC = b"\x00PTB1"
+
     def pack(self, obj: Any) -> bytes:
+        """Serialize ``obj`` for the wire. Bytes-like payloads (the hot
+        case: raw tile bytes, rendezvous reply bodies) skip pickle
+        entirely — one prefix concat instead of a pickle scan+copy."""
+        if isinstance(obj, (bytes, bytearray, memoryview)):
+            return self._RAW_MAGIC + bytes(obj)
         import pickle
         return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
 
-    def unpack(self, data: bytes) -> Any:
+    def unpack(self, data) -> Any:
+        """Inverse of :meth:`pack`. Raw-packed blobs come back as a
+        zero-copy ``memoryview`` into ``data`` (no pickle, no copy)."""
+        view = memoryview(data)
+        n = len(self._RAW_MAGIC)
+        if len(view) >= n and bytes(view[:n]) == self._RAW_MAGIC:
+            return view[n:]
         import pickle
         return pickle.loads(data)
+
+    # --- shared payload codec ----------------------------------------------
+    #: dtype kinds whose buffers ride the wire as raw bytes; everything
+    #: else (object dtypes, exotic extension types) stays pickled
+    RAW_DTYPE_KINDS = "fiub"
+
+    @staticmethod
+    def encode_payload(payload):
+        """Split an array payload for a zero-copy send:
+        ``(meta, raw, inline)`` — ``raw`` is a memoryview straight over
+        the source buffer (no serialization copy) with ``meta = (shape,
+        dtype_str)`` describing it; payloads that cannot travel raw come
+        back as ``inline`` (the transport pickles them). Device arrays
+        materialize host bytes HERE, at the wire boundary. Shared by the
+        TCP fallback frames and the native lane's eager/rendezvous data
+        path."""
+        import numpy as np
+        a = np.ascontiguousarray(np.asarray(payload))
+        if a.dtype.kind in CommEngine.RAW_DTYPE_KINDS:
+            return (tuple(a.shape), a.dtype.str), \
+                memoryview(a).cast("B"), None
+        return None, None, a
+
+    @staticmethod
+    def decode_raw(meta, buf):
+        """Materialize a raw payload: zero-copy ``np.frombuffer`` over
+        the received buffer (the transport owns its lifetime)."""
+        import numpy as np
+        shape, dtype_str = meta
+        return np.frombuffer(buf, np.dtype(dtype_str)).reshape(shape)
 
     def _deliver(self, tag: int, src: int, header: Any, payload: Any) -> bool:
         reg = self._tags.get(tag)

@@ -39,7 +39,7 @@ import numpy as np
 from ..core import termdet as termdet_mod
 from ..utils import mca, output
 from .engine import (CAP_STREAMING, CommEngine, TAG_CNT_AGG, TAG_DTD_AUDIT,
-                     TAG_INTERNAL_GET, TAG_INTERNAL_PUT,
+                     TAG_INTERNAL_GET, TAG_INTERNAL_PUT, TAG_PTCOMM_BOOT,
                      TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
 
 mca.register("comm_eager_limit", 65536,
@@ -109,6 +109,8 @@ class RemoteDepEngine:
         self._td_state: Dict[str, Dict[str, Any]] = {}
         self._enabled = False
         self._comm_thread: Optional[threading.Thread] = None
+        self._comm_polls = 0   # loop iterations (idle-backoff regression)
+        self._comm_event = threading.Event()   # send-side wake for the park
         ce.tag_register(TAG_REMOTE_DEP_ACTIVATE, self._on_activate)
         ce.tag_register(TAG_INTERNAL_GET, self._on_get)
         ce.tag_register(TAG_INTERNAL_PUT, self._on_put)
@@ -119,6 +121,37 @@ class RemoteDepEngine:
         self._cnt_snaps: Dict[int, Dict[int, Dict[str, Any]]] = {}  # epoch->rank->snap
         self._cnt_epoch = 0
         self._cnt_closed = -1   # highest epoch already merged/abandoned
+        #: the native communication lane (comm/native.py): built HERE —
+        #: at protocol-engine construction, when every rank is known to
+        #: be standing up its mesh symmetrically — so taskpools created
+        #: before start() already see it. The boot handler registers
+        #: unconditionally: a peer's bootstrap AM must park, not drop,
+        #: even while this rank is still deciding
+        self.native = None
+        self._ptcomm_box: Dict[int, List[Dict[str, Any]]] = {}
+        ce.tag_register(TAG_PTCOMM_BOOT, self._on_ptcomm_boot)
+        reason = None
+        try:
+            from .native import NativeCommLane
+            reason = NativeCommLane.available(ce)
+            if reason is None:
+                self.native = NativeCommLane(self, ce)
+        except Exception as e:  # noqa: BLE001 — the lane is an optimization
+            reason = f"bootstrap failed: {e}"
+        if reason is not None and ce.nb_ranks > 1:
+            output.debug_verbose(1, "ptcomm",
+                                 f"native comm lane off: {reason}")
+            # tell every peer we are NOT joining, so their bootstraps
+            # abort immediately instead of pumping to the 45 s timeout
+            # (a decline outranks any hello this rank sent before a
+            # mid-bootstrap failure)
+            try:
+                for r in range(ce.nb_ranks):
+                    if r != ce.my_rank:
+                        ce.send_am(TAG_PTCOMM_BOOT, r,
+                                   {"k": "hello", "avail": False}, None)
+            except Exception:  # noqa: BLE001 — peers fall back on timeout
+                pass
         # comm-stream tracing (ref: the comm thread's own profiling stream
         # with typed activate/put/get events + info dictionary,
         # remote_dep_mpi.c:1286-1302); bound lazily to ctx.profiling
@@ -185,11 +218,37 @@ class RemoteDepEngine:
             self._comm_thread.start()
 
     def _comm_main(self) -> None:
-        """Dedicated progress thread (ref: remote_dep_dequeue_main)."""
+        """Dedicated progress thread (ref: remote_dep_dequeue_main).
+
+        Adaptive idle backoff: a fixed 50µs cadence burned a visible
+        slice of a core on a fully idle multi-rank context (20k wakeups/s
+        doing nothing). The loop now spins tight only while traffic
+        flows, escalates its sleep while idle, and finally parks on a
+        dedicated send-side event (set by every command enqueue, cleared
+        here before the re-check so a wakeup can never be missed);
+        inbound frames land via the transport reader threads, which
+        cannot signal the event, so the park is capped at 20ms to stay
+        responsive to pure-receive traffic."""
         import time
+        idle = 0
         while self._enabled:
-            if not self.progress():
-                time.sleep(50e-6)
+            self._comm_polls += 1
+            if self.progress():
+                idle = 0
+                continue
+            idle += 1
+            if idle <= 20:
+                time.sleep(50e-6)           # tight: mid-burst lulls
+            elif idle <= 200:
+                time.sleep(min(2e-3, 50e-6 * idle))   # escalate
+            else:
+                self._comm_event.clear()
+                if not self._cmds:          # re-check: no missed wakeup
+                    self._comm_event.wait(timeout=0.02)
+
+    def _on_ptcomm_boot(self, ce, src, hdr, payload) -> None:
+        """Park native-lane bootstrap AMs (consumed by comm/native.py)."""
+        self._ptcomm_box.setdefault(src, []).append(hdr)
 
     def fini(self) -> None:
         if mca.get("counter_aggregate", False):
@@ -201,7 +260,10 @@ class RemoteDepEngine:
                 output.warning(f"counter aggregation at fini failed: {e}")
         self._enabled = False
         if self._comm_thread is not None:
+            self._comm_event.set()       # unpark for a prompt exit
             self._comm_thread.join(timeout=2.0)
+        if self.native is not None:
+            self.native.fini()
 
     def _pump_until(self, cond, timeout: float) -> bool:
         """Progress-pump until ``cond()`` or timeout (the rank-0 gather
@@ -359,6 +421,7 @@ class RemoteDepEngine:
             return
         tp.addto_nb_pending_actions(1)
         self._cmds.append(("ptg_send", tp, key, ranks, payload))
+        self._comm_event.set()
         self.ctx._work_event.set()
 
     def _do_ptg_send(self, tp, key, ranks, payload) -> None:
@@ -397,6 +460,7 @@ class RemoteDepEngine:
             return
         tp.addto_nb_pending_actions(1)
         self._cmds.append(("send", tp, tile.key, version, ranks, payload))
+        self._comm_event.set()
         self.ctx._work_event.set()
 
     def _do_send(self, tp, tile_key, version, ranks, payload) -> None:

@@ -425,13 +425,10 @@ class TCPCE(CommEngine):
             if is_device:
                 from ..utils.counters import counters
                 counters.add("comm.host_materialized_msgs")
-            a = np.ascontiguousarray(np.asarray(payload))
-            if a.dtype.kind in "fiub":   # exotic dtypes (bf16) ride pickle
-                meta = (tuple(a.shape), a.dtype.str)
-                raw = memoryview(a).cast("B")
-                inline = None
-            else:
-                inline = a
+            # shared zero-copy codec (CommEngine.encode_payload): raw
+            # buffers ship straight from the source array; exotic dtypes
+            # stay inline (pickled with the frame header)
+            meta, raw, inline = self.encode_payload(payload)
         _send_frame(self._peers[dst], self._peer_locks[dst],
                     (_KIND_AM, tag, self.my_rank, header, inline, meta), raw)
 

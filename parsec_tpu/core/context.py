@@ -203,6 +203,13 @@ class Context:
         #: walk is GIL-free, so in-process workers scale on real cores)
         self._ptexec_q: List = []
         self._ptexec_lock = threading.Lock()
+        #: count of COMM-BOUND lane graphs in flight: while one lives,
+        #: starvation backoff is capped near the wire latency — the comm
+        #: progress thread ingests remote releases GIL-free at any
+        #: moment, and a millisecond-scale sleep between lane polls would
+        #: put the hot loop (not the wire) on the critical path of every
+        #: cross-rank dependency chain
+        self._ptexec_comm_live = 0
         #: the per-context native DTD engine (set by DTDTaskpool) and the
         #: count of LIVE batched-lane pools: while any pool has the
         #: batched insert lane armed, every stream's hot loop drains the
@@ -467,6 +474,8 @@ class Context:
         self._ntrace_attach("ptexec", lane["graph"], tp.taskpool_id)
         with self._ptexec_lock:
             self._ptexec_q.append((tp, lane))
+            if lane.get("pool_id") is not None:
+                self._ptexec_comm_live += 1
         self._work_event.set()
 
     def _ptexec_drain(self, stream: ExecutionStream) -> bool:
@@ -500,10 +509,27 @@ class Context:
             budget = 1 << 22
         try:
             mine = graph.run(lane["callback"], 256, budget)
+            if mine == 0 and lane.get("pool_id") is not None \
+                    and not graph.failed() and not graph.done():
+                # comm-bound lane starved mid-graph: the next ready task
+                # arrives from the comm progress thread (GIL-free), not
+                # from this process — micro-poll briefly instead of
+                # paying a full hot-loop iteration per cross-rank hop
+                # (bounded: ~1ms, then the outer loop resumes its usual
+                # error/deadline/device servicing)
+                for spin in range(224):
+                    # yield-spin first (the GIL is free: the comm thread
+                    # runs without it), then ease into short naps
+                    time.sleep(0 if spin < 200 else 2e-5)
+                    mine = graph.run(lane["callback"], 256, budget)
+                    if mine or graph.failed() or graph.done():
+                        break
         except BaseException as e:  # noqa: BLE001 — a body raised
             with self._ptexec_lock:
                 if self._ptexec_q and self._ptexec_q[0][1] is lane:
                     self._ptexec_q.pop(0)
+                    if lane.get("pool_id") is not None:
+                        self._ptexec_comm_live -= 1
             self._ptexec_abandon(lane)
             if self._error is None:
                 self._error = e
@@ -518,6 +544,8 @@ class Context:
             with self._ptexec_lock:
                 if self._ptexec_q and self._ptexec_q[0][1] is lane:
                     self._ptexec_q.pop(0)
+                    if lane.get("pool_id") is not None:
+                        self._ptexec_comm_live -= 1
             self._ptexec_abandon(lane)
             return True
         if graph.done():
@@ -528,6 +556,8 @@ class Context:
                     fin = True
                 if self._ptexec_q and self._ptexec_q[0][1] is lane:
                     self._ptexec_q.pop(0)
+                    if lane.get("pool_id") is not None:
+                        self._ptexec_comm_live -= 1
             if fin:
                 tp._ptexec_finalize(lane)
                 # ring lifecycle (quiescence): land the finished graph's
@@ -770,7 +800,12 @@ class Context:
                 if deadline is not None and time.monotonic() > deadline:
                     return
                 # exponential backoff while starving (ref: scheduling.c:801-804)
-                time.sleep(min(backoff_max, 1e-6 * (1 << min(misses, 10))))
+                # — capped near the wire latency while a comm-bound lane
+                # graph is in flight: its next ready task arrives from
+                # the comm progress thread, not from this process, and a
+                # ms-scale sleep would dominate every cross-rank hop
+                cap = 2e-5 if self._ptexec_comm_live else backoff_max
+                time.sleep(min(cap, 1e-6 * (1 << min(misses, 10))))
 
     # ------------------------------------------------------------------ task FSM
     def _task_progress(self, stream: ExecutionStream, task: Task,

@@ -1128,8 +1128,19 @@ class PTGTaskpool(Taskpool):
         # itself — in-lane ring events land in the PBP streams, see
         # utils/native_trace.py); only --mca pins_paranoid 1 restores the
         # full per-task Python instrumentation
-        if (not mca.get("ptg_native_exec", True) or ctx.nb_ranks > 1
-                or ctx.comm is not None or ctx.pins.paranoid or ctx.paranoid):
+        if (not mca.get("ptg_native_exec", True) or ctx.pins.paranoid
+                or ctx.paranoid):
+            return None
+        # distributed pools may now ride the lane too — when the native
+        # COMMUNICATION lane (comm/native.py, ISSUE 7) is up: cross-rank
+        # release edges surface as activation frames, payloads move
+        # eager/rendezvous, and arrived activations ingest GIL-free. A
+        # distributed context without that lane (in-process ThreadsCE
+        # fabric, --mca comm_native 0, missing native modules) keeps the
+        # interpreted remote_dep path, counted as ineligible-by-design.
+        distributed = ctx.nb_ranks > 1 and ctx.comm is not None
+        lane_comm = getattr(ctx.comm, "native", None) if distributed else None
+        if (ctx.comm is not None or ctx.nb_ranks > 1) and lane_comm is None:
             return None
         classes = [self._classes[tcs.name]
                    for tcs in self.program.spec.task_classes
@@ -1154,6 +1165,14 @@ class PTGTaskpool(Taskpool):
                 return None
             if key is not None:
                 cache[key] = flat
+        owners = None
+        if lane_comm is not None:
+            # per-task owner ranks (owner-computes affinity) — computed
+            # per INSTANTIATION, never cached: rank_of depends on the
+            # collection dict, which is outside the flatten cache key
+            owners = self._ptexec_owners(classes, flat)
+            if owners is None:
+                return None
         self._ptexec_refusal = None
         if flat["n"] == 0:
             return {"n": 0}
@@ -1173,17 +1192,31 @@ class PTGTaskpool(Taskpool):
             if any(b is not None for b in bodies):
                 callback = self._mk_ptexec_callback(flat["bases"], bodies,
                                                     flat["params"])
-            return {"graph": graph, "callback": callback,
+            lane = {"graph": graph, "callback": callback,
                     "n": flat["n"], "finalized": False}
+            if owners is not None:
+                self._ptexec_bind_comm(lane, lane_comm, owners)
+            return lane
         # data-flow pool: the graph additionally owns slot LIFETIMES (the
         # usagelmt/usagecnt retire protocol); Python owns slot VALUES —
         # one flat list the batched callback reads inputs from and lands
         # outputs into. Memory endpoints were flattened symbolically
         # (collection name + static index) so the cached CSR stays valid
         # across instantiations with different collection dicts.
+        comm_info = None
+        slot_uses = data["slot_uses"]
+        if owners is not None:
+            # distributed data pool: slot usage limits count LOCAL
+            # consumers only (a remote consumer's use is the one payload
+            # send, done at production time), remote input slots pull
+            # their value from the comm lane's payload store, and
+            # produced slots feeding remote consumers ship once per
+            # destination rank
+            comm_info = self._ptexec_comm_data(flat, owners)
+            slot_uses = comm_info["slot_uses"]
         graph = mod.Graph(flat["goals"], flat["off"], flat["succs"],
                           flat["prio"], data["in_off"], data["in_slots"],
-                          data["slot_uses"])
+                          slot_uses)
         slots: List[Any] = [None] * data["n_slots"]
         mem_datas = []
         for dc_name, idx in data["mem_reads"]:
@@ -1199,10 +1232,96 @@ class PTGTaskpool(Taskpool):
                 output.fatal(f"PTG taskpool {self.name}: unknown "
                              f"collection {dc_name!r}")
             writebacks.setdefault(tid, []).append((dj, dc.data_of(*idx)))
-        callback = self._mk_ptexec_data_callback(flat, classes, slots,
-                                                 mem_datas, writebacks)
-        return {"graph": graph, "callback": callback, "slots": slots,
+        lane = {"graph": graph, "slots": slots,
                 "n": flat["n"], "finalized": False}
+        if owners is not None:
+            self._ptexec_bind_comm(lane, lane_comm, owners)
+        lane["callback"] = self._mk_ptexec_data_callback(
+            flat, classes, slots, mem_datas, writebacks,
+            comm=None if comm_info is None else dict(
+                comm_info, lane=lane_comm, pool_id=lane["pool_id"]))
+        return lane
+
+    def _ptexec_owners(self, classes: List[TaskClass],
+                       flat) -> Optional[List[int]]:
+        """Per-task owner ranks in flattened-id order, or None when any
+        rank is out of range (the lane declines rather than misroute)."""
+        nb = self.ctx.nb_ranks
+        owners: List[int] = []
+        for ci, tc in enumerate(classes):
+            params = tc._ptg_spec.params
+            rank_of = tc._ptg_rank_of
+            for key in flat["params"][ci]:
+                try:
+                    r = int(rank_of(dict(zip(params, key))))
+                except Exception:  # noqa: BLE001 — decline, don't die
+                    return None
+                if not 0 <= r < nb:
+                    return None
+                owners.append(r)
+        return owners
+
+    def _ptexec_bind_comm(self, lane: Dict[str, Any], lane_comm,
+                          owners: List[int]) -> None:
+        """Bind a flattened graph to the native comm lane: allocate the
+        rank-consistent pool id, hand the owner table + send vtable to
+        the graph (remote successors then surface as activation frames
+        from the GIL-free release sweep), and route this pool's inbound
+        frames into the graph's ingest entry points. ``lane['n']``
+        becomes the LOCAL task count — the pool accounting a rank owns."""
+        pool_id = lane_comm.pool_id_for(self.name)
+        graph = lane["graph"]
+        n_local = graph.comm_bind(lane_comm.comm.send_capsule(), pool_id,
+                                  self.ctx.my_rank, owners)
+        lane_comm.register_engine(pool_id, graph)
+        lane["pool_id"] = pool_id
+        lane["comm"] = lane_comm
+        lane["n"] = n_local
+        # comm/compute overlap is measured, not asserted: the comm
+        # lane's EV_COMM_* ring joins the same trace the engines feed
+        self.ctx._ntrace_attach("ptcomm", lane_comm.comm)
+
+    def _ptexec_comm_data(self, flat, owners: List[int]) -> Dict[str, Any]:
+        """Distributed data-pool tables, derived per instantiation:
+
+        * ``slot_uses``: LOCAL consumer count per slot (the retire
+          protocol runs rank-local; a remote consumer's use is satisfied
+          by the payload send at production time);
+        * ``remote_in``: input slots whose producer runs elsewhere — the
+          dispatch callback materializes them from the comm lane's
+          payload store (landed eager or pulled rendezvous);
+        * ``feeds``: produced slot -> destination ranks (payload ships
+          once per rank, before the release sweep's activations — FIFO
+          frame order makes eager payloads race-free)."""
+        data = flat["data"]
+        me = self.ctx.my_rank
+        in_off, in_slots = data["in_off"], data["in_slots"]
+        slot_base, cls_of = data["slot_base"], data["cls_of"]
+        ndflows = data["ndflows"]
+        n = flat["n"]
+        task_of_slot = [0] * data["n_slots"]
+        for tid in range(n):
+            base = slot_base[tid]
+            for dj in range(ndflows[cls_of[tid]]):
+                task_of_slot[base + dj] = tid
+        slot_uses = [0] * data["n_slots"]
+        remote_in = set()
+        feeds: Dict[int, List[int]] = {}
+        for tid in range(n):
+            local = owners[tid] == me
+            for k in range(in_off[tid], in_off[tid + 1]):
+                ref = in_slots[k]
+                producer_local = owners[task_of_slot[ref]] == me
+                if local:
+                    slot_uses[ref] += 1
+                    if not producer_local:
+                        remote_in.add(ref)
+                elif producer_local:
+                    dsts = feeds.setdefault(ref, [])
+                    if owners[tid] not in dsts:
+                        dsts.append(owners[tid])
+        return {"slot_uses": slot_uses, "remote_in": frozenset(remote_in),
+                "feeds": feeds}
 
     def _mk_ptexec_callback(self, bases: List[int], bodies,
                             params_by_class):
@@ -1220,7 +1339,7 @@ class PTGTaskpool(Taskpool):
 
     def _mk_ptexec_data_callback(self, flat, classes: List[TaskClass],
                                  slots: List[Any], mem_datas,
-                                 writebacks: Dict[int, List]):
+                                 writebacks: Dict[int, List], comm=None):
         """Batched dispatch for data-flow pools — the lane's replacement
         for generic_prepare_input + the body hook + complete_execution +
         the repo side of generic_release_deps, amortized over one Python
@@ -1239,6 +1358,15 @@ class PTGTaskpool(Taskpool):
           consumer body has run) drop their payload reference — the
           entry-retire moment of core/datarepo.py, one list op instead of
           a locked hash-table dance per use.
+
+        With ``comm`` set (a distributed pool on the native comm lane),
+        two extra moves happen inside the same batched dispatch: input
+        slots produced on another rank materialize from the comm lane's
+        payload store (landed eager, or rendezvous-pulled — readiness was
+        gated in C until the pull completed), and produced slots feeding
+        remote consumers ship BEFORE the engine's release sweep sends
+        their activations, so the per-link FIFO makes eager data
+        race-free by construction.
         """
         from ...data.data import COHERENCY_OWNED as _OWNED
         bases = flat["bases"]
@@ -1266,6 +1394,30 @@ class PTGTaskpool(Taskpool):
         # EMPTY written tuple and the flow forwards the input unchanged
         single = [nd == 1 and w == (0,)
                   for nd, w in zip(ndflows, written_by_class)]
+        if comm is not None:
+            lane, pool = comm["lane"], comm["pool_id"]
+            remote_in, feeds = comm["remote_in"], comm["feeds"]
+        else:
+            lane = pool = None
+            remote_in, feeds = frozenset(), {}
+        has_feeds = bool(feeds)
+        #: remote slots already materialized (so a producer's legitimate
+        #: None payload is not re-fetched); retire clears entries
+        fetched: set = set()
+        _fetch_mu = threading.Lock()
+
+        def _fetch_remote(r):
+            # two workers can dispatch two consumers of the same remote
+            # slot concurrently; take_payload CONSUMES the C-side entry,
+            # so the check-then-fetch must be atomic (rare path: once
+            # per remote slot — the lock never touches local slots)
+            with _fetch_mu:
+                if r in fetched:
+                    return slots[r]
+                v = lane.take_payload(pool, r)
+                slots[r] = v
+                fetched.add(r)
+                return v
 
         def _null_guard(k, i):
             raise RuntimeError(
@@ -1278,6 +1430,9 @@ class PTGTaskpool(Taskpool):
             _base, _cls, _wb = slot_base, cls_of, writebacks
             for j in retired:
                 _slots[j] = None          # the entry-retire moment
+            if fetched:
+                for j in retired:
+                    fetched.discard(j)
             for i in ids:
                 k = _cls[i]
                 fn = fns[k]
@@ -1291,6 +1446,12 @@ class PTGTaskpool(Taskpool):
                     r = _refs[base]
                     if r >= 0:
                         v = _slots[r]
+                        if v is None and r in remote_in \
+                                and r not in fetched:
+                            # produced on another rank: materialize from
+                            # the comm lane's payload store (consumed
+                            # once; later local readers hit _slots[r])
+                            v = _fetch_remote(r)
                     elif r == -1:
                         v = None
                     else:
@@ -1301,6 +1462,14 @@ class PTGTaskpool(Taskpool):
                     if v is None and _uses[base] > 0:
                         _null_guard(k, i)    # parsec.c:1879 source guard
                     _slots[base] = v
+                    if has_feeds:
+                        dsts = feeds.get(base)
+                        if dsts:
+                            # ship BEFORE the release sweep runs: the
+                            # consumer's activation then trails its data
+                            # on the FIFO link
+                            for dst in dsts:
+                                lane.send_payload(dst, pool, base, v)
                     wbs = _wb.get(i)
                     if wbs is None:
                         continue
@@ -1310,7 +1479,11 @@ class PTGTaskpool(Taskpool):
                     for dj in range(nd):
                         r = _refs[base + dj]
                         if r >= 0:
-                            vals.append(_slots[r])
+                            v = _slots[r]
+                            if v is None and r in remote_in \
+                                    and r not in fetched:
+                                v = _fetch_remote(r)
+                            vals.append(v)
                         elif r == -1:
                             vals.append(None)
                         else:
@@ -1326,6 +1499,13 @@ class PTGTaskpool(Taskpool):
                         if v is None and _uses[base + dj] > 0:
                             _null_guard(k, i)
                         _slots[base + dj] = v
+                    if has_feeds:
+                        for dj in range(nd):
+                            dsts = feeds.get(base + dj)
+                            if dsts:
+                                for dst in dsts:
+                                    lane.send_payload(dst, pool, base + dj,
+                                                      vals[dj])
                     wbs = _wb.get(i)
                     if wbs is None:
                         continue
@@ -1349,6 +1529,10 @@ class PTGTaskpool(Taskpool):
         output.debug_verbose(2, "ptg",
                              f"{self.name}: native lane retired "
                              f"{lane['n']} tasks")
+        if lane.get("pool_id") is not None:
+            # stop routing this pool's frames; parked payloads (already
+            # consumed or unreachable) drop with the registration
+            lane["comm"].unregister_engine(lane["pool_id"])
         slots = lane.get("slots")
         if slots:
             # lane-side datarepo accounting into the counter registry
@@ -1376,10 +1560,20 @@ class PTGTaskpool(Taskpool):
         if lane is not None:
             PTEXEC_STATS["pools_engaged"] += 1
             PTEXEC_STATS["tasks_engaged"] += lane["n"]
+            if lane.get("pool_id") is not None:
+                from ...comm.native import PTCOMM_STATS
+                PTCOMM_STATS["pools_engaged"] += 1
+                PTCOMM_STATS["tasks_engaged"] += lane["n"]
             self._ptexec_state = lane
             self.set_nb_tasks(lane["n"])
             if lane["n"]:
                 self.ctx._ptexec_enqueue(self, lane)
+            elif lane.get("pool_id") is not None:
+                # a rank owning zero tasks of a distributed pool still
+                # keeps the registration until the pool is globally done;
+                # nothing will be ingested, unregistration happens at
+                # lane fini (no local finalize will run)
+                pass
             output.debug_verbose(2, "ptg",
                                  f"{self.name}: {lane['n']} tasks on the "
                                  f"native execution lane")
@@ -1389,6 +1583,10 @@ class PTGTaskpool(Taskpool):
                 PTEXEC_STATS["pools_fallback"] += 1
             else:
                 PTEXEC_STATS["pools_ineligible"] += 1
+            if distributed:
+                from ...comm.native import PTCOMM_STATS
+                PTCOMM_STATS["pools_fallback" if self._ptexec_refusal ==
+                             "fallback" else "pools_ineligible"] += 1
         for tcs in self.program.spec.task_classes:
             if tcs.name in agg:
                 continue        # executed above, never scheduled/counted

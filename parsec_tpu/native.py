@@ -122,6 +122,7 @@ def available() -> bool:
 
 _ptdtd_mod = [None, False]   # [module, attempted]
 _ptexec_mod = [None, False]
+_ptcomm_mod = [None, False]
 
 
 def _load_pyext(stem: str, cache):
@@ -176,6 +177,15 @@ def load_ptexec():
     table, batched, with the GIL dropped across the walk (see
     docs/native_exec.md for the eligibility and GIL contract)."""
     return _load_pyext("_ptexec", _ptexec_mod)
+
+
+def load_ptcomm():
+    """The CPython-extension communication lane (native/src/ptcomm.cpp),
+    or None. A funneled C progress thread that multiplexes the cross-rank
+    mesh (TCP fds + same-host shm rings), speaks the fixed binary AM
+    protocol, and ingests activations straight into the ptexec/ptdtd
+    ready structures without the GIL (docs/native_exec.md)."""
+    return _load_pyext("_ptcomm", _ptcomm_mod)
 
 
 class NativeDepTable:

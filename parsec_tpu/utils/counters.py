@@ -101,7 +101,8 @@ def install_native_counters() -> None:
     as samplers under canonical names (``ptexec.*``, ``ptdtd.*``,
     ``trace.*``) so :mod:`parsec_tpu.tools.live_view` and the SDE-style
     snapshot export see the lanes. Idempotent."""
-    from ..dsl import dtd as _dtd                # lazy: avoid import cycles
+    from ..comm import native as _cnative        # lazy: avoid import cycles
+    from ..dsl import dtd as _dtd
     from ..dsl.ptg import compiler as _ptg
     from . import native_trace as _nt
 
@@ -109,9 +110,14 @@ def install_native_counters() -> None:
         return lambda: stats[key]
 
     for stats, prefix in ((_ptg.PTEXEC_STATS, "ptexec"),
-                          (_dtd.PTDTD_STATS, "ptdtd")):
+                          (_dtd.PTDTD_STATS, "ptdtd"),
+                          (_cnative.PTCOMM_STATS, "ptcomm")):
         for key in stats:
             counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
+    # the comm lane's C-side wire counters (summed across live lanes)
+    for key in _cnative.COMM_COUNTER_KEYS:
+        counters.register(f"ptcomm.{key}",
+                          sampler=_cnative.comm_counter_sampler(key))
     counters.register(TRACE_EVENTS_DROPPED, sampler=_nt.total_dropped)
     counters.register(TRACE_EVENTS_NATIVE, sampler=_nt.total_landed)
     counters.register(PTEXEC_SLOTS_RETIRED)   # accumulator: lane finalize adds

@@ -57,6 +57,12 @@ EVENT_SIZE = struct.calcsize(_EVENT_FMT)
 NATIVE_KEYWORDS: Dict[str, Dict[int, str]] = {
     "ptexec": {1: "ptexec::task", 2: "ptexec::dispatch"},
     "ptdtd": {1: "ptdtd::link", 2: "ptdtd::exec", 3: "ptdtd::task"},
+    # the comm lane's EV_COMM_* points (native/src/ptcomm.cpp): one
+    # per-rank progress-thread stream, so compute/comm overlap is
+    # measurable in the same Perfetto view as the execution lanes
+    "ptcomm": {1: "ptcomm::act_tx", 2: "ptcomm::act_rx",
+               3: "ptcomm::data_tx", 4: "ptcomm::data_rx",
+               5: "ptcomm::rdv_get", 6: "ptcomm::rdv_rep"},
 }
 
 #: live bridges, for the process-wide drop/landed samplers

@@ -15,9 +15,22 @@ import re
 import numpy as np
 import pytest
 
-from parsec_tpu.parallel.multihost import run_multicontroller
+from parsec_tpu.parallel.multihost import (cpu_collectives_available,
+                                           run_multicontroller)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: failure signatures that mean the ENVIRONMENT cannot run multiprocess
+#: CPU jobs — not that the runtime regressed. "Multiprocess computations
+#: aren't implemented" is a jaxlib without CPU collectives; a
+#: gloo::EnforceNotMet C++ abort (e.g. "op.preamble.length <= op.nbytes")
+#: is the known-buggy gloo TCP pair in some jaxlib builds, uncatchable in
+#: Python. Real assertion failures match neither and still fail.
+_ENV_LIMIT_SIGNATURES = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "gloo::EnforceNotMet",
+    "op.preamble.length <= op.nbytes",
+)
 
 
 def _losses(out: str):
@@ -26,8 +39,24 @@ def _losses(out: str):
     return [float(v) for v in m.group(1).split(",")]
 
 
+def _run_or_skip_on_env_limit(*args, **kw):
+    """run_multicontroller, skipping (not failing) when the failure is an
+    attributed environment limit (the _needs_transfer-style guard, but
+    for faults only observable by running)."""
+    try:
+        return run_multicontroller(*args, **kw)
+    except RuntimeError as e:
+        msg = str(e)
+        for sig in _ENV_LIMIT_SIGNATURES:
+            if sig in msg:
+                pytest.skip(f"multihost CPU backend env-limited: {sig!r}")
+        raise
+
+
 def test_two_controller_global_mesh_lm_train_step():
-    outs = run_multicontroller(
+    if not cpu_collectives_available():
+        pytest.skip("multiprocess CPU collectives unavailable in this jax")
+    outs = _run_or_skip_on_env_limit(
         2, os.path.join(REPO, "tests", "_multihost_worker.py"),
         devices_per_proc=4)
     l0, l1 = _losses(outs[0]), _losses(outs[1])
