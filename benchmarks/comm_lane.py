@@ -155,6 +155,145 @@ def data_program(rank, ce, nt=16, depth=32, ts=32, native=True, reps=3):
     return _finish(rank, ce, ctx, tp, rates, {"checked": checked})
 
 
+def obs_chain_program(rank, ce, nt=8, depth=8, base_port=0, trace_dir=None,
+                      reps=2):
+    """The observability-plane leg (ISSUE 8): the same cross-rank chain,
+    run traced + histogrammed with a live per-rank metrics endpoint.
+    Mid-run each rank scrapes BOTH endpoints (its own and the peer's —
+    the cross-process proof), and at teardown dumps its per-rank .pbp
+    for the parent's clock-aligned merge gate."""
+    import os
+
+    _force_cpu()
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    from parsec_tpu.tools.metrics_server import fetch
+    from parsec_tpu.utils import mca
+    from parsec_tpu.utils.trace import Profiling
+
+    mca.set("metrics_port", base_port)    # rank r serves base_port + r
+    mca.set("hist_enabled", True)
+    ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=ce.nb_ranks)
+    ctx.profiling = Profiling()
+    eng = RemoteDepEngine(ctx, ce)
+    A = TwoDimBlockCyclic("descA", depth, nt, 1, 1, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    prog = compile_ptg(CHAIN_SRC, "obs-comm-chain")
+    scrapes = []
+    tp = None
+    for r in range(reps):
+        ce.sync()
+        tp = prog.instantiate(ctx, globals={"NT": nt, "DEPTH": depth},
+                              collections={"descA": A},
+                              name=f"obs-comm-chain-{r}")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=300)
+        ce.sync()
+        if r == 0:
+            # mid-run scrape: runtime (and peer) still live — both
+            # endpoints must answer from whichever process curls them
+            mine = fetch(f"http://127.0.0.1:{base_port + rank}")
+            peer = fetch(f"http://127.0.0.1:{base_port + (1 - rank)}")
+            hists = fetch(f"http://127.0.0.1:{base_port + rank}",
+                          "/histograms")
+            health = fetch(f"http://127.0.0.1:{base_port + (1 - rank)}",
+                           "/health")
+            scrapes.append({"mine": mine, "peer": peer, "hists": hists,
+                            "peer_health": health})
+            ce.sync()        # neither rank tears down before both scraped
+    engaged = tp._ptexec_state is not None and \
+        tp._ptexec_state.get("pool_id") is not None
+    clk_ok = eng.clock_sync_wait(timeout=10.0)
+    stats = ctx.comm.native.comm.stats() if ctx.comm.native else None
+    ce.sync()
+    ctx.fini()
+    pbp = None
+    if trace_dir:
+        pbp = os.path.join(trace_dir, f"rank{rank}.pbp")
+        ctx.profiling.dump(pbp)
+    ce.fini()
+    return {"rank": rank, "engaged": engaged, "scrapes": scrapes,
+            "clock_ok": clk_ok, "offset_ns": eng._clk_offset_ns,
+            "rtt_ns": eng._clk_rtt_ns, "trace": pbp,
+            "stats": {k: (list(v) if isinstance(v, list) else v)
+                      for k, v in stats.items()} if stats else None}
+
+
+def _free_port_pair() -> int:
+    """A base port such that (base, base+1) are both currently free."""
+    import socket as _socket
+    for _ in range(64):
+        s0 = _socket.socket()
+        s0.bind(("127.0.0.1", 0))
+        base = s0.getsockname()[1]
+        s1 = _socket.socket()
+        try:
+            s1.bind(("127.0.0.1", base + 1))
+        except OSError:
+            continue
+        finally:
+            s1.close()
+            s0.close()
+        return base
+    raise RuntimeError("no adjacent free port pair")
+
+
+def obs_gate(nt: int = 8, depth: int = 8) -> None:
+    """ci.sh cross-rank observability gate: (1) `/metrics` live on both
+    ranks MID-RUN with nonzero ptcomm wire counters, latency percentiles
+    present, and zero frame errors; (2) the two per-rank traces merge
+    into one clock-aligned timeline where EVERY cross-rank activation
+    frame pairs into a send->ingest flow (zero unmatched)."""
+    import functools
+    import json
+    import tempfile
+
+    from parsec_tpu.comm.tcp import run_distributed_procs
+    from parsec_tpu.tools.trace_reader import merge_to_chrome
+
+    base = _free_port_pair()
+    tmp = tempfile.mkdtemp(prefix="ptobs-")
+    res = run_distributed_procs(
+        2, functools.partial(obs_chain_program, nt=nt, depth=depth,
+                             base_port=base, trace_dir=tmp), timeout=300)
+    for rank, r in enumerate(res):
+        assert r["engaged"], f"rank {rank} fell off the native comm lane"
+        sc = r["scrapes"][0]
+        assert sc["peer_health"]["ok"] and \
+            sc["peer_health"]["rank"] == 1 - rank, sc["peer_health"]
+        for side, who in (("mine", rank), ("peer", 1 - rank)):
+            m = sc[side]
+            assert m["rank"] == who, (side, m["rank"], who)
+            c = m["counters"]
+            assert c["ptcomm.acts_tx"] > 0 and c["ptcomm.acts_rx"] > 0, c
+            assert c["ptcomm.frame_errors"] == 0, c
+            assert c["ptexec.pools_engaged"] >= 1, c
+            assert m["percentiles"].get("ptexec.exec_ns", {}) \
+                .get("count", 0) > 0, m["percentiles"]
+        assert sc["hists"]["histograms"], "no raw histograms served"
+        assert r["clock_ok"], "clock sync never completed"
+        assert abs(r["offset_ns"]) < 50_000_000, r["offset_ns"]
+        assert r["stats"]["frame_errors"] == 0, r["stats"]
+    # ---- merged-trace gate: every activation frame pairs -----------------
+    ctf, flows = merge_to_chrome([r["trace"] for r in res])
+    assert not flows["unmatched_tx"], flows["unmatched_tx"][:5]
+    assert not flows["unmatched_rx"], flows["unmatched_rx"][:5]
+    frames = sum(r["stats"]["act_frames_tx"] for r in res)
+    assert len(flows["pairs"]) == frames, (len(flows["pairs"]), frames)
+    # causality on the aligned clock: sends precede their ingests (the
+    # offset estimate's error bound is ~rtt/2, so allow a millisecond)
+    late = [p for p in flows["pairs"] if p[4] < p[3] - 1e-3]
+    assert not late, late[:5]
+    nflow = len([e for e in ctf["traceEvents"] if e.get("ph") in ("s", "f")])
+    assert nflow == 2 * len(flows["pairs"])
+    json.dumps(ctf)     # the artifact Perfetto loads must serialize
+    print(f"observability gate OK: metrics live on both ranks mid-run, "
+          f"{len(flows['pairs'])} cross-rank flow pairs (0 unmatched), "
+          f"|offset| = {max(abs(r['offset_ns']) for r in res)} ns")
+
+
 def ci_gate(nt: int = 8, depth: int = 8) -> None:
     """The ci.sh comm-lane engagement gate: a 2-OS-rank chain whose every
     edge crosses ranks must ride the native lane (activation frames
@@ -192,3 +331,5 @@ if __name__ == "__main__":
         os.path.abspath(__file__))))
     if "--ci-gate" in sys.argv:
         ci_gate()
+    if "--obs-gate" in sys.argv:
+        obs_gate()

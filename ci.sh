@@ -114,6 +114,14 @@ echo "== native comm lane engagement smoke (2 ranks) =="
 # spawned ranks re-import the main module, which stdin cannot provide.
 JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/comm_lane.py --ci-gate
 
+echo "== cross-rank observability smoke (metrics endpoint + merged trace) =="
+# ISSUE 8: /metrics must answer LIVE on both ranks mid-run (cross-process
+# scrape: each rank curls the peer's endpoint) with nonzero ptcomm wire
+# counters + latency percentiles and zero frame errors; the two per-rank
+# .pbp traces must merge into one clock-aligned timeline where EVERY
+# cross-rank activation frame pairs into a send->ingest flow event
+JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/comm_lane.py --obs-gate
+
 echo "== traced native-lane smoke (observer-effect gate) =="
 # profiling must NOT eject pools from the native lanes (PR 5): a traced
 # chain run keeps the same engagement as an untraced one, writes a .pbp

@@ -62,6 +62,7 @@
 #include <vector>
 
 #include "ptcomm_iface.h"
+#include "pthist.h"
 #include "ptrace_ring.h"
 
 namespace {
@@ -74,6 +75,24 @@ constexpr uint32_t EV_COMM_DATA_TX = 3;  // POINT, id = payload bytes
 constexpr uint32_t EV_COMM_DATA_RX = 4;  // POINT, id = payload bytes
 constexpr uint32_t EV_COMM_RDV = 5;      // POINT, id = handle (GET issued)
 constexpr uint32_t EV_COMM_REP = 6;      // POINT, id = payload bytes served
+// cross-rank flow identity (ISSUE 8): every K_ACTS frame carries a
+// per-link sequence number in hdr.aux; both ends record a POINT whose id
+// encodes (peer_rank << 40) | seq, so the offline multi-rank trace merge
+// (tools/trace_reader.merge_traces) can pair each send with the peer's
+// ingest and draw one causal flow arrow per cross-rank activation frame
+constexpr uint32_t EV_COMM_FRAME_TX = 7;
+constexpr uint32_t EV_COMM_FRAME_RX = 8;
+constexpr uint64_t FRAME_SEQ_MASK = (1ull << 40) - 1;
+
+inline int64_t frame_flow_id(int peer, uint64_t seq) {
+    return (int64_t)(((uint64_t)peer << 40) | (seq & FRAME_SEQ_MASK));
+}
+
+// latency histogram slots (pthist.h; names mirrored in utils/hist.py)
+constexpr int H_RDV = 0;      // rendezvous GETREQ -> GETREP round trip
+constexpr int H_QUEUE = 1;    // activation enqueue -> wire (send-queue lag)
+constexpr int N_HISTS = 2;
+const char *const HIST_NAMES[N_HISTS] = {"rdv_rtt_ns", "act_queue_ns"};
 
 constexpr uint64_t HELLO_MAGIC = 0x7074636f6d6d0001ull;  // "ptcomm" v1
 constexpr uint32_t SHM_MAGIC = 0x50434d52;               // "PCMR"
@@ -140,6 +159,7 @@ struct SendOp {
     uint8_t kind = 0;
     uint32_t pool = 0, arg = 0;
     uint64_t aux = 0;
+    int64_t t_enq = 0;         // enqueue stamp (act_queue_ns histogram)
     std::string meta;
     std::string inl;           // eager payload / inline body
     uint64_t rdv_handle = 0;   // K_GETREP: body streams from registration
@@ -161,6 +181,7 @@ struct PayloadEntry {
     bool complete = false;
     uint16_t src = 0;
     uint64_t handle = 0;
+    int64_t t_req = 0;   // rendezvous pull-issued stamp (rdv_rtt_ns)
 };
 
 struct RdvReg {
@@ -205,7 +226,17 @@ struct Comm {
     std::atomic<int64_t> out_pending;  // bytes queued but not yet on a wire
 
     std::atomic<ptrace_ring::State *> trace;
+    std::atomic<pthist::State<N_HISTS> *> hist;
+    // per-destination K_ACTS frame sequence (flow pairing); touched only
+    // by the frame-building side (progress thread or pump), no atomics
+    std::vector<uint64_t> *act_seq;
 };
+
+inline pthist::State<N_HISTS> *hist_of(Comm *self) {
+    pthist::State<N_HISTS> *hs = self->hist.load(std::memory_order_acquire);
+    if (hs && !hs->enabled.load(std::memory_order_relaxed)) hs = nullptr;
+    return hs;
+}
 
 // ---------------------------------------------------------------- helpers
 
@@ -245,6 +276,7 @@ extern "C" void comm_send_act_c(void *comm, int32_t dst, uint32_t pool,
     op->kind = K_ACT_ONE;
     op->pool = pool;
     op->arg = (uint32_t)tid;
+    if (hist_of(self)) op->t_enq = ptrace_ring::now_ns();
     sq_push(self, op);
 }
 
@@ -271,6 +303,12 @@ void put_frame(Comm *self, Peer *p, uint8_t kind, uint32_t pool,
 int drain_sendq(Comm *self, ptrace_ring::Writer &tw) {
     SendOp *head = self->sq.exchange(nullptr, std::memory_order_acquire);
     if (!head) return 0;
+    // the sq exchange (acquire) pairs with the enqueuer's release push:
+    // a trace/hist enable sequenced before that push is visible NOW even
+    // if the loop-top open ran before the enable landed — re-check here
+    // so the first frames after an attach are never silently unrecorded
+    if (!tw.st) tw.open(self->trace.load(std::memory_order_acquire));
+    pthist::State<N_HISTS> *hs = hist_of(self);
     // reverse the Treiber stack: per-producer FIFO order restored
     SendOp *rev = nullptr;
     while (head) {
@@ -305,25 +343,38 @@ int drain_sendq(Comm *self, ptrace_ring::Writer &tw) {
         if (op->kind == K_ACT_ONE) {
             // coalesce consecutive activations for the same (dst, pool)
             // into one K_ACTS frame: 4 bytes per tid instead of a frame
+            const int64_t h_now = hs ? ptrace_ring::now_ns() : 0;
             std::string ids;
             ids.append(reinterpret_cast<const char *>(&op->arg), 4);
             int32_t dst = op->dst;
             uint32_t pool = op->pool;
+            if (h_now && op->t_enq > 0)
+                hs->h[H_QUEUE].add(h_now - op->t_enq);
             SendOp *nx = op->next;
             delete op;
             while (nx && nx->kind == K_ACT_ONE && nx->dst == dst &&
                    nx->pool == pool) {
                 ids.append(reinterpret_cast<const char *>(&nx->arg), 4);
+                if (h_now && nx->t_enq > 0)
+                    hs->h[H_QUEUE].add(h_now - nx->t_enq);
                 SendOp *nn = nx->next;
                 delete nx;
                 nx = nn;
             }
             rev = nx;
-            put_frame(self, p, K_ACTS, pool, 0, 0, ids.data(), ids.size());
+            // per-link frame sequence rides hdr.aux so the receiver's
+            // ingest pairs with this send in the merged timeline
+            uint64_t seq = ++(*self->act_seq)[(size_t)dst];
+            put_frame(self, p, K_ACTS, pool, 0, seq, ids.data(),
+                      ids.size());
             int64_t cnt = (int64_t)(ids.size() / 4);
             self->acts_tx.fetch_add(cnt, std::memory_order_relaxed);
             self->act_frames_tx.fetch_add(1, std::memory_order_relaxed);
-            if (tw.st) tw.rec(EV_COMM_ACT_TX, cnt, ptrace_ring::FLAG_POINT);
+            if (tw.st) {
+                tw.rec(EV_COMM_ACT_TX, cnt, ptrace_ring::FLAG_POINT);
+                tw.rec(EV_COMM_FRAME_TX, frame_flow_id(dst, seq),
+                       ptrace_ring::FLAG_POINT);
+            }
             n++;
             continue;
         }
@@ -551,7 +602,12 @@ void dispatch_frame(Comm *self, Peer *p, const WireHdr &h, const char *body,
             }
             self->acts_rx.fetch_add(cnt, std::memory_order_relaxed);
             self->act_frames_rx.fetch_add(1, std::memory_order_relaxed);
-            if (tw.st) tw.rec(EV_COMM_ACT_RX, cnt, ptrace_ring::FLAG_POINT);
+            if (tw.st) {
+                tw.rec(EV_COMM_ACT_RX, cnt, ptrace_ring::FLAG_POINT);
+                if (h.aux)
+                    tw.rec(EV_COMM_FRAME_RX, frame_flow_id(h.src, h.aux),
+                           ptrace_ring::FLAG_POINT);
+            }
             return;
         }
         case K_DATA: {
@@ -610,6 +666,7 @@ void dispatch_frame(Comm *self, Peer *p, const WireHdr &h, const char *body,
                 e.complete = false;
                 e.src = h.src;
                 e.handle = h.aux;
+                e.t_req = hist_of(self) ? ptrace_ring::now_ns() : 0;
             }
             const PtCommIngestVtbl &v = it->second.v;
             if (v.rdv_begin) v.rdv_begin(v.obj, (int32_t)h.arg);
@@ -654,6 +711,12 @@ void dispatch_frame(Comm *self, Peer *p, const WireHdr &h, const char *body,
                 PayloadEntry &e = (*self->payloads)[pay_key(h.pool, h.arg)];
                 e.data.assign(body, h.body_len);
                 e.complete = true;
+                pthist::State<N_HISTS> *hs = hist_of(self);
+                if (hs && e.t_req > 0) {
+                    // the wire round trip of the rendezvous pull
+                    hs->h[H_RDV].add(ptrace_ring::now_ns() - e.t_req);
+                    e.t_req = 0;
+                }
             }
             if (it->second.v.rdv_land)
                 it->second.v.rdv_land(it->second.v.obj, (int32_t)h.arg);
@@ -680,6 +743,11 @@ void replay_early_locked(Comm *self, uint32_t pool,
     auto it = self->pools->find(pool);
     if (it == self->pools->end()) return;
     const PtCommIngestVtbl &v = it->second.v;
+    // replays are the receiver's ingest for frames that raced the pool
+    // registration: they must record the same flow points as the live
+    // dispatch path, or the merged timeline would report unmatched sends
+    ptrace_ring::Writer tw;
+    tw.open(self->trace.load(std::memory_order_acquire));
     for (EarlyFrame &f : frames) {
         switch (f.h.kind) {
             case K_ACTS:
@@ -691,6 +759,14 @@ void replay_early_locked(Comm *self, uint32_t pool,
                 self->acts_rx.fetch_add(f.h.body_len / 4,
                                         std::memory_order_relaxed);
                 self->act_frames_rx.fetch_add(1, std::memory_order_relaxed);
+                if (tw.st) {
+                    tw.rec(EV_COMM_ACT_RX, f.h.body_len / 4,
+                           ptrace_ring::FLAG_POINT);
+                    if (f.h.aux)
+                        tw.rec(EV_COMM_FRAME_RX,
+                               frame_flow_id(f.h.src, f.h.aux),
+                               ptrace_ring::FLAG_POINT);
+                }
                 break;
             case K_DATA: {
                 if (f.h.body_len < 4) break;
@@ -717,6 +793,7 @@ void replay_early_locked(Comm *self, uint32_t pool,
                     e.complete = false;
                     e.src = f.h.src;
                     e.handle = f.h.aux;
+                    e.t_req = hist_of(self) ? ptrace_ring::now_ns() : 0;
                 }
                 if (v.rdv_begin) v.rdv_begin(v.obj, (int32_t)f.h.arg);
                 SendOp *op = new (std::nothrow) SendOp();
@@ -738,6 +815,8 @@ void replay_early_locked(Comm *self, uint32_t pool,
 }
 
 int pump_recv(Comm *self, ptrace_ring::Writer &tw) {
+    // late-attach visibility: see the matching re-open in drain_sendq
+    if (!tw.st) tw.open(self->trace.load(std::memory_order_acquire));
     int n = 0;
     char tmp[65536];
     for (Peer *p : *self->peers) {
@@ -880,9 +959,13 @@ PyObject *comm_new(PyTypeObject *type, PyObject *args, PyObject *) {
           &self->loops})
         new (c) std::atomic<int64_t>(0);
     new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
+    new (&self->hist) std::atomic<pthist::State<N_HISTS> *>(nullptr);
+    self->act_seq = new (std::nothrow)
+        std::vector<uint64_t>((size_t)nb_ranks, 0);
     if (!self->peers || !self->pools_mu || !self->pools || !self->early ||
         !self->retired || !self->pay_mu || !self->payloads ||
-        !self->rdv_mu || !self->rdv || !self->rdv_release) {
+        !self->rdv_mu || !self->rdv || !self->rdv_release ||
+        !self->act_seq) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -957,7 +1040,9 @@ void comm_dealloc(PyObject *obj) {
     delete self->rdv_mu;
     delete self->rdv;
     delete self->rdv_release;
+    delete self->act_seq;
     delete self->trace.load(std::memory_order_acquire);
+    delete self->hist.load(std::memory_order_acquire);
     Py_TYPE(obj)->tp_free(obj);
 }
 
@@ -1411,6 +1496,22 @@ PyObject *comm_monotonic_ns(PyObject *, PyObject *) {
     return PyLong_FromLongLong(ptrace_ring::now_ns());
 }
 
+PyObject *comm_hist_enable(PyObject *obj, PyObject *) {
+    return pthist::py_hist_enable<N_HISTS>(
+        reinterpret_cast<Comm *>(obj)->hist);
+}
+
+PyObject *comm_hist_disable(PyObject *obj, PyObject *) {
+    return pthist::py_hist_disable<N_HISTS>(
+        reinterpret_cast<Comm *>(obj)->hist.load(std::memory_order_acquire));
+}
+
+PyObject *comm_hist_snapshot(PyObject *obj, PyObject *) {
+    return pthist::py_hist_snapshot<N_HISTS>(
+        reinterpret_cast<Comm *>(obj)->hist.load(std::memory_order_acquire),
+        HIST_NAMES);
+}
+
 PyMethodDef comm_methods[] = {
     {"add_peer_fd", comm_add_peer_fd, METH_VARARGS,
      "add_peer_fd(rank, fd): adopt (dup) a connected stream socket"},
@@ -1451,6 +1552,12 @@ PyMethodDef comm_methods[] = {
     {"trace_dropped", comm_trace_dropped, METH_NOARGS,
      "events lost to ring overflow"},
     {"monotonic_ns", comm_monotonic_ns, METH_NOARGS, "the trace clock"},
+    {"hist_enable", comm_hist_enable, METH_NOARGS,
+     "arm the wire latency histograms (rdv_rtt_ns, act_queue_ns)"},
+    {"hist_disable", comm_hist_disable, METH_NOARGS,
+     "stop recording (buckets are kept)"},
+    {"hist_snapshot", comm_hist_snapshot, METH_NOARGS,
+     "{name: (count, sum_ns, buckets_bytes)} — buckets pack '<496Q'"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyTypeObject CommType = [] {
@@ -1489,6 +1596,9 @@ PyMODINIT_FUNC PyInit__ptcomm(void) {
         PyModule_AddIntConstant(m, "EV_COMM_DATA_RX", EV_COMM_DATA_RX) < 0 ||
         PyModule_AddIntConstant(m, "EV_COMM_RDV", EV_COMM_RDV) < 0 ||
         PyModule_AddIntConstant(m, "EV_COMM_REP", EV_COMM_REP) < 0 ||
+        PyModule_AddIntConstant(m, "EV_COMM_FRAME_TX", EV_COMM_FRAME_TX) < 0 ||
+        PyModule_AddIntConstant(m, "EV_COMM_FRAME_RX", EV_COMM_FRAME_RX) < 0 ||
+        PyModule_AddIntConstant(m, "HIST_BUCKETS", pthist::NBUCKETS) < 0 ||
         PyModule_AddIntConstant(m, "SHM_MAGIC", (long)SHM_MAGIC) < 0 ||
         PyModule_AddIntConstant(m, "SHM_DATA_OFF", (long)SHM_DATA_OFF) < 0) {
         Py_DECREF(m);

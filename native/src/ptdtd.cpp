@@ -62,6 +62,7 @@
 #include <vector>
 
 #include "ptcomm_iface.h"
+#include "pthist.h"
 #include "ptrace_ring.h"
 
 namespace {
@@ -75,6 +76,12 @@ constexpr uint32_t EV_LINK = 1;   // one interval per insert_many link batch
 constexpr uint32_t EV_EXEC = 2;   // one interval per (class, batch) dispatch
 constexpr uint32_t EV_TASK = 3;   // one point per batch-lane task completion
 
+// latency histogram slots (pthist.h; names mirrored in utils/hist.py)
+constexpr int H_EXEC = 0;     // per-task (class,batch) latency, amortized
+constexpr int H_READY = 1;    // batch-lane ready-push -> drain-pop wait
+constexpr int N_HISTS = 2;
+const char *const HIST_NAMES[N_HISTS] = {"exec_ns", "ready_wait_ns"};
+
 constexpr Py_ssize_t PT_FLOWS_MAX = 64;
 
 struct TaskRec {
@@ -82,6 +89,7 @@ struct TaskRec {
     bool completed = false;
     uint32_t stamp = 0;           // pred-dedup visit stamp
     int32_t cls = -1;             // batch-lane class id (-1: per-task lane)
+    int64_t ready_ns = 0;         // ready-push stamp (histograms; under mu)
     int64_t flow_off = 0;         // into the flow arena (batch lane only)
     int32_t flow_n = 0;
     PyObject *vals = nullptr;     // by-value args tuple (batch lane, owned)
@@ -126,6 +134,8 @@ struct Engine {
     std::atomic<int64_t> ingest_bad;   // out-of-range/completed ids
     // in-lane event rings (null until trace_enable)
     std::atomic<ptrace_ring::State *> trace;
+    // latency histograms (null until hist_enable)
+    std::atomic<pthist::State<N_HISTS> *> hist;
 };
 
 PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
@@ -146,6 +156,7 @@ PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
     new (&self->acts_rx) std::atomic<int64_t>(0);
     new (&self->ingest_bad) std::atomic<int64_t>(0);
     new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
+    new (&self->hist) std::atomic<pthist::State<N_HISTS> *>(nullptr);
     if (!self->mu || !self->tasks || !self->tiles || !self->classes ||
         !self->flow_tile || !self->flow_acc || !self->ready ||
         !self->rsurf) {
@@ -176,6 +187,7 @@ void engine_dealloc(PyObject *obj) {
     delete self->ready;
     delete self->rsurf;
     delete self->trace.load(std::memory_order_acquire);
+    delete self->hist.load(std::memory_order_acquire);
     Py_TYPE(obj)->tp_free(obj);
 }
 
@@ -275,9 +287,10 @@ int64_t link_locked(Engine *self, const int64_t *tixs, const int64_t *laccs,
 // Marks `tid` completed and decrements its successors; newly-ready
 // batch-lane successors go straight onto the internal ready structure,
 // newly-ready per-task-lane successors are appended to `surfaced` for
-// Python to schedule.
+// Python to schedule. ``now`` (0 = histograms off) stamps ready pushes
+// for the ready-wait histogram — captured once per caller batch.
 void complete_locked(Engine *self, int64_t tid,
-                     std::vector<int64_t> &surfaced) {
+                     std::vector<int64_t> &surfaced, int64_t now = 0) {
     std::vector<TaskRec> &tasks = *self->tasks;
     TaskRec &rec = tasks[(size_t)tid];
     rec.completed = true;
@@ -288,12 +301,21 @@ void complete_locked(Engine *self, int64_t tid,
     for (int64_t s : succs) {
         TaskRec &sr = tasks[(size_t)s];
         if (--sr.deps_remaining == 0) {
-            if (sr.cls >= 0)
+            if (sr.cls >= 0) {
+                sr.ready_ns = now;
                 self->ready->push_back(s);
-            else
+            } else {
                 surfaced.push_back(s);
+            }
         }
     }
+}
+
+// one acquire load per engine entry point; disabled degrades to null
+inline pthist::State<N_HISTS> *hist_of(Engine *self) {
+    pthist::State<N_HISTS> *hs = self->hist.load(std::memory_order_acquire);
+    if (hs && !hs->enabled.load(std::memory_order_relaxed)) hs = nullptr;
+    return hs;
 }
 
 // insert(tile_ids: list|tuple[int], accs: list|tuple[int])
@@ -413,7 +435,8 @@ PyObject *engine_complete(PyObject *obj, PyObject *arg) {
                             "complete() on a batch-lane task");
             return nullptr;
         }
-        complete_locked(self, tid, surfaced);
+        complete_locked(self, tid, surfaced,
+                        hist_of(self) ? ptrace_ring::now_ns() : 0);
     }
     PyObject *tup = PyTuple_New((Py_ssize_t)surfaced.size());
     if (!tup) return nullptr;
@@ -567,11 +590,14 @@ PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
     // the whole batch links under ONE GIL drop
     ptrace_ring::Writer tw;
     tw.open(self->trace.load(std::memory_order_acquire));
+    pthist::State<N_HISTS> *hs = hist_of(self);
     PyThreadState *ts = PyEval_SaveThread();
     if (tw.st) tw.rec(EV_LINK, (int64_t)ntask, ptrace_ring::FLAG_START);
     {
         std::lock_guard<std::mutex> lk(*self->mu);
         std::vector<TaskRec> &tasks = *self->tasks;
+        // ready-wait stamp, one clock read for the whole link batch
+        const int64_t h_now = hs ? ptrace_ring::now_ns() : 0;
         const int64_t base = (int64_t)self->flow_tile->size();
         self->flow_tile->insert(self->flow_tile->end(), ftile.begin(),
                                 ftile.end());
@@ -587,8 +613,10 @@ PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
             rec.vals = sp.vals;           // ownership moves to the record
             // count-then-activate: the record is fully stored; drop the
             // guard. 0 deps -> straight onto the internal ready structure
-            if (--rec.deps_remaining == 0)
+            if (--rec.deps_remaining == 0) {
+                rec.ready_ns = h_now;
                 self->ready->push_back(tid);
+            }
         }
     }
     if (tw.st) tw.rec(EV_LINK, (int64_t)ntask, ptrace_ring::FLAG_END);
@@ -617,6 +645,7 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
     long long total = 0;
     ptrace_ring::Writer tw;
     tw.open(self->trace.load(std::memory_order_acquire));
+    pthist::State<N_HISTS> *hs = hist_of(self);
     std::vector<int64_t> surfaced;
     // (cls, tid) pairs: cls is snapshotted while the pops hold the mutex —
     // a concurrent insert_many links with the GIL DROPPED (mutex held) and
@@ -631,10 +660,14 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
             std::lock_guard<std::mutex> lk(*self->mu);
             if (self->poisoned || self->ready->empty()) break;
             size_t take = std::min((size_t)max_batch, self->ready->size());
+            const int64_t h_now = hs ? ptrace_ring::now_ns() : 0;
             for (size_t k = self->ready->size() - take;
                  k < self->ready->size(); k++) {
                 int64_t tid = (*self->ready)[k];
-                local.emplace_back((*self->tasks)[(size_t)tid].cls, tid);
+                TaskRec &rec = (*self->tasks)[(size_t)tid];
+                if (h_now && rec.ready_ns > 0)
+                    hs->h[H_READY].add(h_now - rec.ready_ns);
+                local.emplace_back(rec.cls, tid);
             }
             self->ready->resize(self->ready->size() - take);
         }
@@ -709,6 +742,7 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
                 }
             }
             // phase 2 (mutex released): build the args list and dispatch
+            const int64_t h_t0 = hs ? ptrace_ring::now_ns() : 0;
             if (tw.st) tw.rec(EV_EXEC, cls, ptrace_ring::FLAG_START);
             PyObject *args_list = PyList_New((Py_ssize_t)gn);
             PyObject *outs = nullptr;
@@ -767,6 +801,7 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
             defer_decref.clear();
             {
                 std::lock_guard<std::mutex> lk(*self->mu);
+                const int64_t h_now = hs ? ptrace_ring::now_ns() : 0;
                 for (size_t t = gi; t < gj; t++) {
                     TaskRec &rec = (*self->tasks)[(size_t)local[t].second];
                     if (nwrites) {
@@ -793,9 +828,16 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
                     if (tw.st)
                         tw.rec(EV_TASK, local[t].second,
                                ptrace_ring::FLAG_POINT);
-                    complete_locked(self, local[t].second, surfaced);
+                    complete_locked(self, local[t].second, surfaced, h_now);
                 }
                 self->batch_done += (int64_t)gn;
+            }
+            if (hs) {
+                // per-task (class, batch) latency: gather + dispatch +
+                // landing + release amortized over the batch
+                int64_t per =
+                    (ptrace_ring::now_ns() - h_t0) / (int64_t)gn;
+                hs->h[H_EXEC].add(per, gn);
             }
             if (tw.st) tw.rec(EV_EXEC, cls, ptrace_ring::FLAG_END);
             for (PyObject *p : defer_decref) Py_DECREF(p);
@@ -1044,6 +1086,35 @@ PyObject *engine_monotonic_ns(PyObject *, PyObject *) {
     return PyLong_FromLongLong(ptrace_ring::now_ns());
 }
 
+// --------------------------------------------------- latency histograms
+
+PyObject *engine_hist_enable(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *r = pthist::py_hist_enable<N_HISTS>(self->hist);
+    if (!r) return nullptr;
+    // tasks already awaiting drain get a real push stamp
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        int64_t now = ptrace_ring::now_ns();
+        for (int64_t t : *self->ready)
+            (*self->tasks)[(size_t)t].ready_ns = now;
+    }
+    return r;
+}
+
+PyObject *engine_hist_disable(PyObject *obj, PyObject *) {
+    return pthist::py_hist_disable<N_HISTS>(
+        reinterpret_cast<Engine *>(obj)->hist.load(
+            std::memory_order_acquire));
+}
+
+PyObject *engine_hist_snapshot(PyObject *obj, PyObject *) {
+    return pthist::py_hist_snapshot<N_HISTS>(
+        reinterpret_cast<Engine *>(obj)->hist.load(
+            std::memory_order_acquire),
+        HIST_NAMES);
+}
+
 // deps_remaining(task_id) -> int  (diagnostics / paranoid checks)
 PyObject *engine_deps_remaining(PyObject *obj, PyObject *arg) {
     Engine *self = reinterpret_cast<Engine *>(obj);
@@ -1103,10 +1174,12 @@ void dtd_ingest_act_c(void *obj, int32_t tid) {
     }
     self->acts_rx.fetch_add(1, std::memory_order_relaxed);
     if (--rec.deps_remaining == 0) {
-        if (rec.cls >= 0)
+        if (rec.cls >= 0) {
+            rec.ready_ns = hist_of(self) ? ptrace_ring::now_ns() : 0;
             self->ready->push_back(tid);
-        else
+        } else {
             self->rsurf->push_back(tid);
+        }
     }
 }
 
@@ -1195,6 +1268,13 @@ PyMethodDef engine_methods[] = {
      "cumulative events lost to ring overflow (never reset)"},
     {"monotonic_ns", engine_monotonic_ns, METH_NOARGS,
      "the trace clock (steady_clock ns) — for epoch calibration"},
+    {"hist_enable", engine_hist_enable, METH_NOARGS,
+     "arm the batch-lane latency histograms (exec_ns amortized per "
+     "(class,batch), ready_wait_ns push->pop; see pthist.h)"},
+    {"hist_disable", engine_hist_disable, METH_NOARGS,
+     "stop recording (buckets are kept)"},
+    {"hist_snapshot", engine_hist_snapshot, METH_NOARGS,
+     "{name: (count, sum_ns, buckets_bytes)} — buckets pack '<496Q'"},
     {"deps_remaining", engine_deps_remaining, METH_O,
      "deps_remaining(task_id) -> int"},
     {"pending", engine_pending, METH_NOARGS,
@@ -1436,7 +1516,9 @@ PyMODINIT_FUNC PyInit__ptdtd(void) {
     }
     if (PyModule_AddIntConstant(m, "EV_LINK", EV_LINK) < 0 ||
         PyModule_AddIntConstant(m, "EV_EXEC", EV_EXEC) < 0 ||
-        PyModule_AddIntConstant(m, "EV_TASK", EV_TASK) < 0) {
+        PyModule_AddIntConstant(m, "EV_TASK", EV_TASK) < 0 ||
+        PyModule_AddIntConstant(m, "HIST_BUCKETS", pthist::NBUCKETS) < 0 ||
+        PyModule_AddIntConstant(m, "HIST_SUB_BITS", pthist::SUB_BITS) < 0) {
         Py_DECREF(m);
         return nullptr;
     }

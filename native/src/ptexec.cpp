@@ -54,6 +54,7 @@
 #include <vector>
 
 #include "ptcomm_iface.h"
+#include "pthist.h"
 #include "ptrace_ring.h"
 
 namespace {
@@ -62,6 +63,15 @@ namespace {
 // utils/native_trace.py; see ptrace_ring.h for the ring contract)
 constexpr uint32_t EV_TASK = 1;      // one interval per task's retire step
 constexpr uint32_t EV_DISPATCH = 2;  // one interval per batched body dispatch
+
+// latency histogram slots (pthist.h; names mirrored in utils/hist.py)
+constexpr int H_EXEC = 0;        // per-task execute latency (batch-amortized)
+constexpr int H_READY = 1;       // ready-push -> pop wait (sampled 1-in-8)
+constexpr int N_HISTS = 2;
+const char *const HIST_NAMES[N_HISTS] = {"exec_ns", "ready_wait_ns"};
+// deterministic 1-in-8 sample by task id: the armed per-task cost of the
+// ready-wait histogram is one predictable branch on 7/8 of the tasks
+inline bool hist_sampled(int32_t tid) { return (tid & 7) == 0; }
 
 struct Graph {
     PyObject_HEAD
@@ -90,6 +100,12 @@ struct Graph {
     // in-lane event rings (null until trace_enable; one relaxed check per
     // run() call when tracing never was enabled)
     std::atomic<ptrace_ring::State *> trace;
+    // latency histograms (null until hist_enable; same gating discipline)
+    std::atomic<pthist::State<N_HISTS> *> hist;
+    // per-task ready-push timestamp for the ready-wait histogram: written
+    // only when histograms are armed AND the id is sampled; atomics
+    // because the comm progress thread stamps ingested tasks GIL-free
+    std::atomic<int64_t> *ready_stamp;
     // distributed mode (comm_bind): per-task owner ranks; edges into a
     // non-local successor surface as activation frames on the comm lane's
     // send queue instead of local decrements, and ingest_act() lets the
@@ -193,6 +209,9 @@ void graph_reset_state(Graph *self) {
     self->completed = 0;
     self->running = 0;
     self->error = false;
+    if (self->ready_stamp)
+        for (int64_t i = 0; i < self->n; i++)
+            self->ready_stamp[i].store(0, std::memory_order_relaxed);
 }
 
 PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
@@ -220,6 +239,8 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     self->use_heap = false;
     self->n_slots = 0;
     new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
+    new (&self->hist) std::atomic<pthist::State<N_HISTS> *>(nullptr);
+    self->ready_stamp = nullptr;
     self->owners = new (std::nothrow) std::vector<int32_t>();
     self->rdv_pending = new (std::nothrow) std::vector<uint8_t>();
     self->parked = new (std::nothrow) std::vector<int32_t>();
@@ -367,6 +388,16 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
         PyErr_NoMemory();
         return nullptr;
     }
+    // allocated at build (8 bytes/task) so hist_enable mid-run never
+    // races a GIL-free worker against a growing buffer; written only
+    // when histograms are armed
+    self->ready_stamp = new (std::nothrow)
+        std::atomic<int64_t>[(size_t)self->n];
+    if (self->n && !self->ready_stamp) {
+        Py_DECREF(self);
+        PyErr_NoMemory();
+        return nullptr;
+    }
     graph_reset_state(self);
     return reinterpret_cast<PyObject *>(self);
 }
@@ -389,7 +420,9 @@ void graph_dealloc(PyObject *obj) {
     delete self->parked;
     delete[] self->counts;
     delete[] self->slot_cnt;
+    delete[] self->ready_stamp;
     delete self->trace.load(std::memory_order_acquire);
+    delete self->hist.load(std::memory_order_acquire);
     Py_TYPE(obj)->tp_free(obj);
 }
 
@@ -462,6 +495,11 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
     ptrace_ring::Writer tw;
     tw.open(self->trace.load(std::memory_order_acquire));
     const bool tr = tw.st != nullptr;
+    // latency histograms: one acquire load per run() call; a disabled
+    // state degrades to the same null branch as never-enabled
+    pthist::State<N_HISTS> *hs = self->hist.load(std::memory_order_acquire);
+    if (hs && !hs->enabled.load(std::memory_order_relaxed)) hs = nullptr;
+    int64_t h_t0 = 0;
     PyThreadState *ts = PyEval_SaveThread();   // GIL dropped for the walk
     for (;;) {
         bool stop = false;
@@ -488,6 +526,19 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             }
         }
         if (stop) break;
+        if (hs) {
+            // ready-queue wait (sampled): pop time minus the stamped
+            // push time; unstamped ids (armed mid-flight) are skipped.
+            // One clock read per batch — reused as the exec-latency start
+            int64_t now = ptrace_ring::now_ns();
+            for (int32_t t : local) {
+                if (!hist_sampled(t)) continue;
+                int64_t s0 =
+                    self->ready_stamp[t].load(std::memory_order_relaxed);
+                if (s0 > 0) hs->h[H_READY].add(now - s0);
+            }
+            h_t0 = now;
+        }
         if (callback != Py_None) {
             PyEval_RestoreThread(ts);
             ts = nullptr;
@@ -575,6 +626,15 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
         }
         if (sent)
             self->acts_tx.fetch_add(sent, std::memory_order_relaxed);
+        if (hs && !fresh.empty()) {
+            // stamp sampled newly-ready ids before they enter the ready
+            // structure (one clock read per release batch; plain stores)
+            int64_t now = ptrace_ring::now_ns();
+            for (int32_t s : fresh)
+                if (hist_sampled(s))
+                    self->ready_stamp[s].store(now,
+                                               std::memory_order_relaxed);
+        }
         {
             std::lock_guard<std::mutex> lk(*self->mu);
             self->completed += (int64_t)local.size();
@@ -599,6 +659,16 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                                       freed.end());
                 self->nb_slots_retired += (int64_t)freed.size();
             }
+        }
+        if (hs && !local.empty()) {
+            // per-task execute latency, batch-amortized: the whole
+            // dispatch + release sweep cost divided across the batch,
+            // bumped once with the batch count — two clock reads and
+            // three atomics per ~256 tasks keeps the armed overhead
+            // inside the <2% contract
+            int64_t per = (ptrace_ring::now_ns() - h_t0) /
+                          (int64_t)local.size();
+            hs->h[H_EXEC].add(per, local.size());
         }
         mine += (int64_t)local.size();
         local.clear();
@@ -659,6 +729,12 @@ void graph_ingest_act_c(void *obj, int32_t tid) {
     }
     self->acts_rx.fetch_add(1, std::memory_order_relaxed);
     if (self->counts[tid].fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        pthist::State<N_HISTS> *hs =
+            self->hist.load(std::memory_order_acquire);
+        if (hs && hs->enabled.load(std::memory_order_relaxed) &&
+            hist_sampled(tid))
+            self->ready_stamp[tid].store(ptrace_ring::now_ns(),
+                                         std::memory_order_relaxed);
         std::lock_guard<std::mutex> lk(*self->mu);
         push_ready_locked(self, tid);
     }
@@ -847,6 +923,40 @@ PyObject *graph_monotonic_ns(PyObject *, PyObject *) {
     return PyLong_FromLongLong(ptrace_ring::now_ns());
 }
 
+// --------------------------------------------------- latency histograms
+
+PyObject *graph_hist_enable(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    PyObject *r = pthist::py_hist_enable<N_HISTS>(self->hist);
+    if (!r) return nullptr;
+    // stamp sampled tasks ALREADY awaiting pop (seeds, mid-run arming)
+    // so their eventual pop reads a real push time, not zero
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        int64_t now = ptrace_ring::now_ns();
+        for (int32_t t : *self->ready)
+            if (hist_sampled(t))
+                self->ready_stamp[t].store(now, std::memory_order_relaxed);
+        for (int32_t t : *self->parked)
+            if (hist_sampled(t))
+                self->ready_stamp[t].store(now, std::memory_order_relaxed);
+    }
+    return r;
+}
+
+PyObject *graph_hist_disable(PyObject *obj, PyObject *) {
+    return pthist::py_hist_disable<N_HISTS>(
+        reinterpret_cast<Graph *>(obj)->hist.load(
+            std::memory_order_acquire));
+}
+
+PyObject *graph_hist_snapshot(PyObject *obj, PyObject *) {
+    return pthist::py_hist_snapshot<N_HISTS>(
+        reinterpret_cast<Graph *>(obj)->hist.load(
+            std::memory_order_acquire),
+        HIST_NAMES);
+}
+
 PyMethodDef graph_methods[] = {
     {"run", graph_run, METH_VARARGS,
      "run(callback=None, batch=256, budget=0) -> tasks executed by this call"},
@@ -889,6 +999,13 @@ PyMethodDef graph_methods[] = {
      "cumulative events lost to ring overflow (never reset)"},
     {"monotonic_ns", graph_monotonic_ns, METH_NOARGS,
      "the trace clock (steady_clock ns) — for epoch calibration"},
+    {"hist_enable", graph_hist_enable, METH_NOARGS,
+     "arm the in-lane latency histograms (exec_ns batch-amortized, "
+     "ready_wait_ns sampled 1-in-8 by task id; see pthist.h)"},
+    {"hist_disable", graph_hist_disable, METH_NOARGS,
+     "stop recording (buckets are kept)"},
+    {"hist_snapshot", graph_hist_snapshot, METH_NOARGS,
+     "{name: (count, sum_ns, buckets_bytes)} — buckets pack '<496Q'"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyTypeObject GraphType = [] {
@@ -922,7 +1039,10 @@ PyMODINIT_FUNC PyInit__ptexec(void) {
         return nullptr;
     }
     if (PyModule_AddIntConstant(m, "EV_TASK", EV_TASK) < 0 ||
-        PyModule_AddIntConstant(m, "EV_DISPATCH", EV_DISPATCH) < 0) {
+        PyModule_AddIntConstant(m, "EV_DISPATCH", EV_DISPATCH) < 0 ||
+        PyModule_AddIntConstant(m, "HIST_BUCKETS", pthist::NBUCKETS) < 0 ||
+        PyModule_AddIntConstant(m, "HIST_SUB_BITS", pthist::SUB_BITS) < 0 ||
+        PyModule_AddIntConstant(m, "HIST_READY_SAMPLE", 8) < 0) {
         Py_DECREF(m);
         return nullptr;
     }

@@ -33,6 +33,7 @@ TAG_REMOTE_DEP_ACTIVATE = 2
 TAG_TERMDET = 3
 TAG_DSL_BASE = 4          # TTG-style DSL reservations start here
 TAG_PTCOMM_BOOT = 8       # native comm lane bootstrap (comm/native.py)
+TAG_CLOCKSYNC = 9         # rank-0 clock-offset ping-pong (remote_dep.py)
 TAG_CNT_AGG = 10          # cross-rank counter aggregation at fini
 TAG_DTD_AUDIT = 11        # DTD replay-consistency auditor exchange
 

@@ -473,6 +473,10 @@ class NativeCommLane:
             self.ctx._ntrace_detach(self.comm)
         except Exception:  # noqa: BLE001 — no bridge attached
             pass
+        try:
+            self.ctx._hist_detach(self.comm)
+        except Exception:  # noqa: BLE001 — no histograms armed
+            pass
         self.comm.stop()
         self.reap()
         self._teardown_segments()

@@ -32,15 +32,16 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 from ..core import termdet as termdet_mod
 from ..utils import mca, output
-from .engine import (CAP_STREAMING, CommEngine, TAG_CNT_AGG, TAG_DTD_AUDIT,
-                     TAG_INTERNAL_GET, TAG_INTERNAL_PUT, TAG_PTCOMM_BOOT,
-                     TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
+from .engine import (CAP_STREAMING, CommEngine, TAG_CLOCKSYNC, TAG_CNT_AGG,
+                     TAG_DTD_AUDIT, TAG_INTERNAL_GET, TAG_INTERNAL_PUT,
+                     TAG_PTCOMM_BOOT, TAG_REMOTE_DEP_ACTIVATE, TAG_TERMDET)
 
 mca.register("comm_eager_limit", 65536,
              "Payloads up to this many bytes ride inside the activate AM", type=int)
@@ -52,6 +53,11 @@ mca.register("counter_aggregate", False,
              "Gather every rank's counter snapshot at fini and print a "
              "merged per-rank + sum table on rank 0 (aggregator_visu role)",
              type=bool)
+mca.register("clock_sync_samples", 16,
+             "Ping-pong exchanges per rank for the rank-0 clock-offset "
+             "estimate (min-RTT sample wins; the estimate's error is "
+             "bounded by that sample's RTT/2). The offset rebases this "
+             "rank's trace timestamps in the multi-rank merge", type=int)
 
 
 def bcast_children(ranks: Sequence[int], me: int, algo: str) -> List[Tuple[int, List[int]]]:
@@ -159,6 +165,22 @@ class RemoteDepEngine:
         self._pstream = None
         self._pkeys: Dict[str, int] = {}
         self._pev = 0
+        # rank-0 clock offset (ISSUE 8): a non-blocking ping-pong state
+        # machine over the AM plane — each rank r>0 measures
+        # ``local_clock - rank0_clock`` (perf_counter_ns, the SAME clock
+        # the PBP traces record) by min-RTT midpoint; the multi-rank
+        # trace merge rebases every rank onto rank 0's clock with it.
+        # Rank 0 (and single-rank contexts) are trivially offset 0
+        self._clk_lock = threading.Lock()
+        self._clk_samples: List[Tuple[int, int]] = []   # (offset_ns, rtt_ns)
+        self._clk_done = ce.my_rank == 0 or ce.nb_ranks < 2
+        self._clk_offset_ns: Optional[int] = 0 if self._clk_done else None
+        self._clk_rtt_ns: Optional[int] = 0 if self._clk_done else None
+        self._clk_peers_done: Set[int] = set()   # rank 0: peers that finished
+        self._clk_stream = None                  # per-tracer meta stream
+        self._clk_stream_prof = None
+        ce.tag_register(TAG_CLOCKSYNC, self._on_clocksync)
+        self._install_clock_counters()
 
     # ------------------------------------------------------- comm tracing
     COMM_EVENTS = ("activate_snd", "activate_rcv", "get_snd", "get_rcv",
@@ -206,12 +228,134 @@ class RemoteDepEngine:
                                      eager=int(eager))
         s.trace(self._pkeys[kind], self._pev, 0, EVENT_FLAG_POINT, info)
 
+    # ------------------------------------------------------------ clock sync
+    def _install_clock_counters(self) -> None:
+        """``comm.clock_offset_ns`` / ``comm.clock_rtt_ns`` in the
+        unified registry (weakly bound: a registry sampler must never
+        pin a dead engine alive)."""
+        import weakref
+
+        from ..utils.counters import counters
+        wself = weakref.ref(self)
+
+        def _sample(attr):
+            def sample():
+                s = wself()
+                v = getattr(s, attr, None) if s is not None else None
+                return float("nan") if v is None else v
+            return sample
+
+        counters.register("comm.clock_offset_ns",
+                          sampler=_sample("_clk_offset_ns"))
+        counters.register("comm.clock_rtt_ns", sampler=_sample("_clk_rtt_ns"))
+
+    def _clk_ping(self) -> None:
+        """Issue one ping toward rank 0 (non-blocking; the pong handler
+        chains the next one until enough samples landed)."""
+        if self._clk_done:
+            return
+        self.ce.send_am(TAG_CLOCKSYNC, 0,
+                        {"k": "ping", "t0": time.perf_counter_ns()}, None)
+
+    def _on_clocksync(self, ce, src, hdr, payload) -> None:
+        kind = hdr.get("k")
+        if kind == "ping":
+            # answer with our clock reading; the requester brackets it
+            ce.send_am(TAG_CLOCKSYNC, src,
+                       {"k": "pong", "t0": hdr["t0"],
+                        "ts": time.perf_counter_ns()}, None)
+            return
+        if kind == "done":      # a peer's estimate landed (rank 0 only)
+            self._clk_peers_done.add(src)
+            return
+        t1 = time.perf_counter_ns()
+        with self._clk_lock:
+            if self._clk_done:
+                return          # late/duplicate pong after finalize
+            rtt = t1 - hdr["t0"]
+            # symmetric-delay midpoint: rank 0 read its clock at ~our
+            # (t0+t1)/2, so offset = local - rank0; error <= rtt/2
+            self._clk_samples.append(
+                ((hdr["t0"] + t1) // 2 - hdr["ts"], rtt))
+            if len(self._clk_samples) >= \
+                    max(2, mca.get("clock_sync_samples", 16)):
+                off, rtt = min(self._clk_samples, key=lambda s: s[1])
+                self._clk_offset_ns = off
+                self._clk_rtt_ns = rtt
+                self._clk_done = True
+        if self._clk_done:
+            self.stamp_clock_meta()
+            # let rank 0 stop pumping on our behalf (clock_sync_wait)
+            self.ce.send_am(TAG_CLOCKSYNC, 0, {"k": "done"}, None)
+        else:
+            self._clk_ping()
+
+    def clock_sync_wait(self, timeout: float = 5.0) -> bool:
+        """Pump until the offset estimate lands. On rank 0 — whose own
+        offset is trivially 0 — this instead pumps until every PEER
+        reported its estimate done: the ladder only advances while rank
+        0 answers pings, so a rank-0 caller that stopped progressing
+        (post-run barriers don't pump AMs) would strand the peers'
+        remaining round trips. Collective in spirit: call it on every
+        rank (the gates/tests do) before relying on the metadata."""
+        self._clk_ping()
+        if self.ce.my_rank == 0 and self.ce.nb_ranks > 1:
+            want = self.ce.nb_ranks - 1
+            return self._pump_until(
+                lambda: len(self._clk_peers_done) >= want, timeout)
+        return self._pump_until(lambda: self._clk_done, timeout)
+
+    def clock_sync_finalize(self, timeout: float = 2.0) -> None:
+        """Context.fini hook, called BEFORE the trace is stamped and
+        dumped: give an unfinished ladder one bounded collective pump.
+        Rank 0 participates too (its own estimate is trivially done, but
+        the peers' ladders only advance while it answers pings — without
+        this, every peer would burn its full timeout against a silent
+        rank 0). No-op once everything already completed, which is the
+        common case: the ladder usually finishes during the run."""
+        if self.ce.nb_ranks < 2 or not self._enabled:
+            return
+        self.clock_sync_wait(timeout)
+
+    def stamp_clock_meta(self) -> None:
+        """Land one ``meta::clock`` POINT event (rank, offset, min-RTT)
+        into the attached tracer — the per-rank metadata the multi-rank
+        merge (tools/trace_reader.merge_traces) reads to rebase this
+        rank's timestamps onto rank 0's clock. Called when the estimate
+        lands and again defensively before any dump. Idempotent per
+        tracer once the estimate is COMPLETE; an incomplete (ok=0) stamp
+        does NOT latch, so a ladder that finishes later still lands its
+        real offset — trace_reader.clock_meta prefers the ok=1 record."""
+        prof = getattr(self.ctx, "profiling", None)
+        if prof is None or not getattr(prof, "enabled", True):
+            return
+        if getattr(prof, "_clk_stamped", False):
+            return
+        from ..utils.trace import EVENT_FLAG_POINT
+        start, _ = prof.add_dictionary_keyword(
+            "meta::clock", info_desc="rank{i};peer{i};offset_ns{q};"
+                                     "rtt_ns{q};ok{i}")
+        # one stream per tracer (Profiling.stream always appends): an
+        # ok=0 stamp followed by the completed one re-uses it instead of
+        # minting duplicate identically-named streams in the dump
+        if self._clk_stream is None or self._clk_stream_prof is not prof:
+            self._clk_stream = prof.stream(f"clock(rank {self.ce.my_rank})")
+            self._clk_stream_prof = prof
+        info = prof.pack_info(
+            "meta::clock", rank=self.ce.my_rank, peer=0,
+            offset_ns=self._clk_offset_ns or 0,
+            rtt_ns=self._clk_rtt_ns or 0, ok=int(self._clk_done))
+        self._clk_stream.trace(start, 0, 0, EVENT_FLAG_POINT, info)
+        if self._clk_done:
+            prof._clk_stamped = True
+
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
         """parsec_remote_dep_on: wake the comm machinery."""
         if self._enabled:
             return
         self._enabled = True
+        self._clk_ping()        # kick the clock-offset estimate
         if mca.get("comm_thread", False):
             self._comm_thread = threading.Thread(
                 target=self._comm_main, name="parsec-tpu-comm", daemon=True)
@@ -251,6 +395,11 @@ class RemoteDepEngine:
         self._ptcomm_box.setdefault(src, []).append(hdr)
 
     def fini(self) -> None:
+        # clock-sync finalization (the bounded collective pump) already
+        # ran from Context.fini BEFORE the trace was stamped/dumped;
+        # here only the defensive stamp remains, for direct rde.fini
+        # users whose tracer never got one (no-op once latched)
+        self.stamp_clock_meta()
         if mca.get("counter_aggregate", False):
             try:
                 table = self.aggregate_counters()
@@ -779,8 +928,21 @@ class RemoteDepEngine:
         rank ships its counters.py snapshot to rank 0, which merges them
         into per-rank columns + a SUM row. Returns the merged table on
         rank 0 (None elsewhere). Enabled at fini via --mca
-        counter_aggregate 1."""
-        from ..utils.counters import counters
+        counter_aggregate 1.
+
+        Lane-aware (ISSUE 8): a ptcomm-engaged run largely bypasses this
+        module, so the rollup would silently miss the native wire unless
+        the lanes' samplers (``ptcomm.*`` C-side counters, ``ptexec.*``/
+        ``ptdtd.*`` engagement, latency percentiles) are installed in the
+        registry before the snapshot — done here, idempotently, so the
+        fini table covers whichever path carried the run. The exchange
+        itself stays on the CE AM plane, which outlives the native lane
+        (NativeCommLane.fini runs after this in RemoteDepEngine.fini)."""
+        from ..utils.counters import counters, install_native_counters
+        try:
+            install_native_counters()
+        except Exception:  # noqa: BLE001 — partial native: keep the rest
+            pass
         snap = counters.snapshot()
         epoch = self._cnt_epoch
         self._cnt_epoch += 1
@@ -798,11 +960,24 @@ class RemoteDepEngine:
         if missing:
             output.warning(f"counter aggregation: no snapshot from ranks "
                            f"{missing}")
+        import math
+
+        def gauge(k: str) -> bool:
+            # per-rank gauges (latency percentiles, clock offsets) have
+            # no meaningful cross-rank SUM — adding four ranks' p99s
+            # prints a number that LOOKS like a latency but isn't; they
+            # stay in the per-rank columns only
+            return (".hist." in k and not k.endswith(".count")) or \
+                k.startswith("comm.clock_")
+
         per_rank = dict(sorted(got.items()))
         total: Dict[str, Any] = {}
         for s in per_rank.values():
             for k, v in s.items():
-                if isinstance(v, (int, float)):
+                # a NaN sampler (clock offset not yet measured, failing
+                # sampler) must not poison the whole SUM cell
+                if isinstance(v, (int, float)) and math.isfinite(v) \
+                        and not gauge(k):
                     total[k] = total.get(k, 0) + v
         self._cnt_snaps.pop(epoch, None)
         self._cnt_closed = max(self._cnt_closed, epoch)

@@ -224,6 +224,17 @@ class Context:
         #: self.profiling (utils/native_trace.py); created lazily when a
         #: lane arms while profiling is attached — zero cost otherwise
         self._ntrace = None
+        #: per-rank metrics endpoint (tools/metrics_server.py): the
+        #: counter registry + latency percentiles over HTTP/UDS JSON,
+        #: up for the context's whole life (--mca metrics_port / _uds)
+        from ..tools.metrics_server import MetricsServer
+        self.metrics = MetricsServer.maybe_start(my_rank, nb_ranks)
+        #: native latency histograms (utils/hist.py): armed on every
+        #: lane the context enqueues when requested explicitly or
+        #: implied by a live metrics endpoint (/metrics serves live
+        #: percentiles); off = one null branch per lane event site
+        self._hist_on = bool(mca.get("hist_enabled", False)) or \
+            self.metrics is not None
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
 
@@ -259,6 +270,22 @@ class Context:
     def _ntrace_detach(self, obj) -> None:
         if self._ntrace is not None:
             self._ntrace.detach(obj)
+
+    # --------------------------------------------------- latency histograms
+    def _hist_attach(self, kind: str, obj) -> None:
+        """Arm ``obj``'s native latency histograms (pthist.h) when the
+        context wants them; called from the same lifecycle points as
+        :meth:`_ntrace_attach`."""
+        if self._hist_on:
+            from ..utils.hist import histograms
+            histograms.attach(kind, obj)
+
+    def _hist_detach(self, obj) -> None:
+        """Fold a finishing lane object's buckets into the process
+        accumulator so /metrics keeps reporting completed pools."""
+        if self._hist_on:
+            from ..utils.hist import histograms
+            histograms.detach(obj)
 
     def register_drain_hook(self, bound_method) -> None:
         import weakref
@@ -395,6 +422,20 @@ class Context:
         if self._ntrace is not None:
             # fini: land straggler ring events (blocking final drain)
             self._ntrace.drain_all(wait=True)
+        if self.comm is not None and self.profiling is not None and \
+                hasattr(self.comm, "stamp_clock_meta"):
+            # the per-rank clock-offset metadata must land BEFORE any
+            # dump: the multi-rank trace merge reads it to rebase this
+            # rank's timestamps onto rank 0's clock. Finalize the ladder
+            # first (bounded, collective — rank 0 answers the peers'
+            # remaining pings here; only traced runs pay this), THEN
+            # stamp, so the pump's result is what actually gets dumped
+            try:
+                if hasattr(self.comm, "clock_sync_finalize"):
+                    self.comm.clock_sync_finalize(timeout=2.0)
+                self.comm.stamp_clock_meta()
+            except Exception:  # noqa: BLE001 — merge degrades to raw clocks
+                pass
         if self._prof_auto and self.profiling is not None:
             try:
                 self.profiling.dump()
@@ -415,6 +456,16 @@ class Context:
         self.devices.fini()
         if self.comm is not None:
             self.comm.fini()
+        if self._dtd_neng is not None:
+            # the per-context DTD engine never hits a per-pool detach
+            # point: fold its buckets here so the process-wide registry
+            # does not pin one engine per finished context forever
+            self._hist_detach(self._dtd_neng)
+        if self.metrics is not None:
+            # endpoint down LAST: ops dashboards may scrape through the
+            # drain, and the fini counter aggregation itself is scrapeable
+            self.metrics.stop()
+            self.metrics = None
         self._release_gc_hold()  # error paths can finalize w/ pools active
 
     # ------------------------------------------------------------------ scheduling
@@ -472,6 +523,7 @@ class Context:
         # ring lifecycle (enable): arm in-lane tracing before the first
         # burst so no lane event predates its rings
         self._ntrace_attach("ptexec", lane["graph"], tp.taskpool_id)
+        self._hist_attach("ptexec", lane["graph"])
         with self._ptexec_lock:
             self._ptexec_q.append((tp, lane))
             if lane.get("pool_id") is not None:
@@ -563,6 +615,7 @@ class Context:
                 # ring lifecycle (quiescence): land the finished graph's
                 # events and stop pinning it
                 self._ntrace_detach(lane["graph"])
+                self._hist_detach(lane["graph"])
             return True
         return mine > 0
 
@@ -608,6 +661,7 @@ class Context:
         leaking instead would pin every produced payload for the
         taskpool's remaining lifetime."""
         self._ntrace_detach(lane["graph"])   # final drain of an errored lane
+        self._hist_detach(lane["graph"])
         slots = lane.get("slots")
         if not slots:
             return

@@ -505,6 +505,7 @@ class DTDTaskpool(Taskpool):
                 # profiling is attached). The engine is per-CONTEXT and
                 # outlives pools, so its events carry taskpool id 0
                 ctx._ntrace_attach("ptdtd", eng)
+                ctx._hist_attach("ptdtd", eng)
                 # open-batch-pool count gates the stream hot loops' engine
                 # drain; decremented at final completion so pools running
                 # AFTER this one (e.g. with the batch lane mca-disabled)

@@ -1271,15 +1271,20 @@ class PTGTaskpool(Taskpool):
         becomes the LOCAL task count — the pool accounting a rank owns."""
         pool_id = lane_comm.pool_id_for(self.name)
         graph = lane["graph"]
+        # comm/compute overlap is measured, not asserted: the comm
+        # lane's EV_COMM_* ring joins the same trace the engines feed.
+        # Armed BEFORE the pool registration so a frame that lands the
+        # instant routing opens records its ingest point — frames that
+        # raced even earlier park and replay with recording, so the
+        # merged timeline never reports a send without its ingest
+        self.ctx._ntrace_attach("ptcomm", lane_comm.comm)
+        self.ctx._hist_attach("ptcomm", lane_comm.comm)
         n_local = graph.comm_bind(lane_comm.comm.send_capsule(), pool_id,
                                   self.ctx.my_rank, owners)
         lane_comm.register_engine(pool_id, graph)
         lane["pool_id"] = pool_id
         lane["comm"] = lane_comm
         lane["n"] = n_local
-        # comm/compute overlap is measured, not asserted: the comm
-        # lane's EV_COMM_* ring joins the same trace the engines feed
-        self.ctx._ntrace_attach("ptcomm", lane_comm.comm)
 
     def _ptexec_comm_data(self, flat, owners: List[int]) -> Dict[str, Any]:
         """Distributed data-pool tables, derived per instantiation:

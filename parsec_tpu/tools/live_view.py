@@ -2,17 +2,34 @@
 
 Re-design of the reference's ``tools/aggregator_visu`` (a demo server
 exporting MCA counters plus a matplotlib GUI, ``aggregator.py``): a
-background sampler records the counter registry on an interval, and
-:meth:`render` draws the series with matplotlib. Headless-friendly (Agg
-backend) — on a cluster the PNG lands where a dashboard can poll it, which
-is the TPU-pod-operations shape of "live GUI". Cross-rank aggregation at
-fini stays with ``--mca counter_aggregate 1`` (comm/remote_dep.py); this
-module covers the time dimension.
+background sampler records counters on an interval, and :meth:`render`
+draws the series with matplotlib. Headless-friendly (Agg backend) — on a
+cluster the PNG lands where a dashboard can poll it, which is the
+TPU-pod-operations shape of "live GUI".
+
+Two sources (ISSUE 8):
+
+* **in-process** (default): the unified counter registry of THIS process;
+* **cross-process**: pass ``endpoints=[...]`` — one or many rank metrics
+  endpoints (``http://127.0.0.1:port`` / ``unix:/path``, served by
+  ``tools/metrics_server`` from each rank's Context) — and the sampler
+  polls ``/metrics`` over the wire instead, so a real multi-OS-rank run
+  reads as one dashboard. With several endpoints the series are prefixed
+  ``r<rank>.``; an unreachable endpoint counts into ``poll_errors`` and
+  the other ranks keep sampling.
+
+Long runs never lose their early history: hitting ``max_samples``
+decimates the stored series in half (every other sample dropped, counted
+in ``samples_dropped``/``decimations``) instead of silently discarding
+new samples, so the series always spans the whole run at a resolution
+that degrades gracefully.
 
 Usage::
 
     from parsec_tpu.tools.live_view import LiveCounterView
-    view = LiveCounterView(interval_s=0.05)
+    view = LiveCounterView(interval_s=0.05)            # in-process
+    view = LiveCounterView(endpoints=["http://127.0.0.1:9130",
+                                      "http://127.0.0.1:9131"])
     view.start()
     ... run taskpools ...
     view.stop()
@@ -23,41 +40,74 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from ..utils.counters import counters as default_registry
 
 
 class LiveCounterView:
-    """Sample a CounterRegistry on an interval; render the series."""
+    """Sample a CounterRegistry (or remote rank endpoints) on an
+    interval; render the series."""
 
     def __init__(self, registry=None, interval_s: float = 0.1,
-                 max_samples: int = 10000) -> None:
-        if registry is None:
+                 max_samples: int = 10000,
+                 endpoints: Optional[Sequence[str]] = None) -> None:
+        self.endpoints = list(endpoints) if endpoints else None
+        if registry is None and self.endpoints is None:
             # default view: make the native lanes visible (ptexec.*,
             # ptdtd.*, trace.* samplers — idempotent registration)
             from ..utils.counters import install_native_counters
             install_native_counters()
         self.registry = registry if registry is not None else default_registry
         self.interval_s = interval_s
-        self.max_samples = max_samples
+        self.max_samples = max(2, max_samples)
         self.times: List[float] = []
         self.series: Dict[str, List[float]] = {}
+        self.samples_dropped = 0     # samples discarded by decimation
+        self.decimations = 0         # how many times the window halved
+        self.poll_errors = 0         # unreachable-endpoint scrapes
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._t0 = None
 
     # ------------------------------------------------------------- sampling
+    def _snapshot(self) -> Dict[str, float]:
+        if self.endpoints is None:
+            return {k: v for k, v in self.registry.snapshot().items()
+                    if isinstance(v, (int, float))}
+        from .metrics_server import fetch
+        snap: Dict[str, float] = {}
+        many = len(self.endpoints) > 1
+        for ep in self.endpoints:
+            try:
+                m = fetch(ep)
+            except Exception:  # noqa: BLE001 — a dead rank must not
+                self.poll_errors += 1   # stall the other ranks' series
+                continue
+            prefix = f"r{m.get('rank', 0)}." if many else ""
+            for k, v in m.get("counters", {}).items():
+                if isinstance(v, (int, float)):
+                    snap[prefix + k] = v
+        return snap
+
     def sample(self) -> None:
         """Record one snapshot (also usable standalone, without start())."""
-        snap = self.registry.snapshot()
+        snap = self._snapshot()
         now = time.perf_counter()
         with self._lock:
             if self._t0 is None:
                 self._t0 = now
             if len(self.times) >= self.max_samples:
-                return
+                # decimate-in-half: keep every other sample so the series
+                # still covers the full run (half resolution) instead of
+                # silently freezing at the window edge
+                kept = self.times[::2]
+                self.samples_dropped += len(self.times) - len(kept)
+                self.decimations += 1
+                self.times = kept
+                for name in self.series:
+                    self.series[name] = self.series[name][::2]
             self.times.append(now - self._t0)
             for name, v in snap.items():
                 s = self.series.setdefault(name, [0.0] * (len(self.times) - 1))
@@ -87,6 +137,14 @@ class LiveCounterView:
         self._thread.join(timeout=2.0)
         self._thread = None
         self.sample()
+
+    def stats(self) -> Dict[str, int]:
+        """Sampling health: window decimations and endpoint poll errors."""
+        with self._lock:
+            return {"samples": len(self.times),
+                    "samples_dropped": self.samples_dropped,
+                    "decimations": self.decimations,
+                    "poll_errors": self.poll_errors}
 
     # ------------------------------------------------------------- rendering
     def active_series(self) -> Dict[str, List[float]]:

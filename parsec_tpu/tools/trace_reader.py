@@ -213,6 +213,169 @@ def read_trace(path: str) -> TraceData:
     return read_pbp(path)
 
 
+# ------------------------------------------------- multi-rank trace merge
+
+#: the per-rank clock metadata keyword (stamped by
+#: comm/remote_dep.py stamp_clock_meta): one POINT event per rank
+#: carrying (rank, offset_ns to rank 0, min-RTT of the estimate)
+CLOCK_KEYWORD = "meta::clock"
+#: the ptcomm flow-identity keywords (native/src/ptcomm.cpp): POINT
+#: events whose id encodes (peer_rank << 40) | frame_seq
+FRAME_TX = "ptcomm::frame_tx"
+FRAME_RX = "ptcomm::frame_rx"
+_FRAME_SEQ_MASK = (1 << 40) - 1
+
+
+def clock_meta(trace: TraceData) -> Optional[Dict[str, Any]]:
+    """This trace's clock metadata, or None (pre-merge single-rank
+    traces, or a run without a comm engine). A trace may carry several
+    stamps (an incomplete ok=0 one from an early dump plus the completed
+    estimate): the ok=1 record wins, else the last seen."""
+    entry = next((d for d in trace.dictionary
+                  if d["name"] == CLOCK_KEYWORD), None)
+    if entry is None:
+        return None
+    best: Optional[Dict[str, Any]] = None
+    for stream in trace.streams:
+        for key, eid, tpid, t, flags, info in stream["events"]:
+            if key >> 1 != entry["key"] or not info:
+                continue
+            vals = struct.unpack(entry["fmt"], info)
+            meta = {name: v for (name, _), v in zip(entry["fields"], vals)}
+            if meta.get("ok"):
+                return meta
+            best = meta
+    return best
+
+
+def merge_traces(paths: List[str], rebase: bool = True) -> TraceData:
+    """Load N per-rank traces and merge them into ONE TraceData whose
+    timestamps all live on rank 0's clock (the reference's offline
+    profile merge, ``profiling-tools dbp`` merging per-rank .prof files).
+
+    Each rank's ``meta::clock`` event supplies its rank id and its
+    measured ``local - rank0`` offset (min-RTT ping-pong estimate, error
+    bounded by RTT/2); ``rebase=True`` subtracts it from every timestamp.
+    Traces without metadata fall back to positional rank (``paths[i]`` =
+    rank i) and offset 0. Stream names gain an ``r<rank>:`` prefix and
+    dictionaries are unified by keyword name, so the merged trace flows
+    through the whole existing pipeline (dataframe, chrome JSON, SVG)
+    unchanged."""
+    traces = [read_trace(p) for p in paths]
+    merged_dict: List[Dict[str, Any]] = []
+    by_name: Dict[str, int] = {}
+    streams: List[Dict[str, Any]] = []
+    t0 = None
+    for pos, trace in enumerate(traces):
+        meta = clock_meta(trace)
+        rank = int(meta["rank"]) if meta is not None else pos
+        off = (meta["offset_ns"] * 1e-9
+               if rebase and meta is not None else 0.0)
+        keymap: Dict[int, int] = {}
+        for d in trace.dictionary:
+            nk = by_name.get(d["name"])
+            if nk is None:
+                nk = len(merged_dict)
+                by_name[d["name"]] = nk
+                merged_dict.append(dict(d, key=nk))
+            keymap[d["key"]] = nk
+        rt0 = trace.t0 - off
+        t0 = rt0 if t0 is None else min(t0, rt0)
+        for s in trace.streams:
+            events = [((keymap[key >> 1] << 1) | (key & 1), eid, tpid,
+                       t - off, flags, info)
+                      for key, eid, tpid, t, flags, info in s["events"]]
+            streams.append({"name": f"r{rank}:{s['name']}",
+                            "events": events})
+    return TraceData(t0 or 0.0, merged_dict, streams)
+
+
+def _frame_events(trace: TraceData, keyword: str):
+    """(src_rank_of_stream, peer, seq, t) for every flow-identity point.
+    Rank comes from the merged ``r<rank>:`` stream-name prefix."""
+    entry = next((d for d in trace.dictionary if d["name"] == keyword), None)
+    if entry is None:
+        return
+    for stream in trace.streams:
+        name = stream["name"]
+        if not name.startswith("r") or ":" not in name:
+            continue
+        try:
+            rank = int(name[1:name.index(":")])
+        except ValueError:
+            continue
+        for key, eid, tpid, t, flags, info in stream["events"]:
+            if key >> 1 != entry["key"]:
+                continue
+            yield rank, eid >> 40, eid & _FRAME_SEQ_MASK, t
+
+
+def act_flows(trace: TraceData) -> Dict[str, Any]:
+    """Pair every cross-rank activation frame's send with the peer's
+    ingest in a MERGED trace: frame_tx on rank a toward peer b with
+    sequence s matches frame_rx on rank b from peer a with the same s.
+    Returns ``{"pairs": [(src, dst, seq, t_tx, t_rx)], "unmatched_tx",
+    "unmatched_rx"}`` — the ci gate requires both unmatched lists empty
+    (every cross-rank activation reads as one causal edge)."""
+    tx: Dict[Tuple[int, int, int], float] = {}
+    for rank, peer, seq, t in _frame_events(trace, FRAME_TX):
+        tx[(rank, peer, seq)] = t
+    pairs: List[Tuple[int, int, int, float, float]] = []
+    unmatched_rx: List[Tuple[int, int, int]] = []
+    for rank, peer, seq, t in _frame_events(trace, FRAME_RX):
+        t_tx = tx.pop((peer, rank, seq), None)
+        if t_tx is None:
+            unmatched_rx.append((peer, rank, seq))
+        else:
+            pairs.append((peer, rank, seq, t_tx, t))
+    return {"pairs": sorted(pairs, key=lambda p: p[3]),
+            "unmatched_tx": sorted(tx),
+            "unmatched_rx": sorted(unmatched_rx)}
+
+
+def flow_chrome_events(trace: TraceData,
+                       flows: Optional[Dict[str, Any]] = None
+                       ) -> List[Dict[str, Any]]:
+    """Chrome trace-event flow records ("s"/"f" phases) for the paired
+    cross-rank activations, ready to extend a merged trace's
+    ``traceEvents`` — Perfetto draws one arrow per frame from the
+    sender's progress-thread track to the receiver's. Pass an
+    :func:`act_flows` result to avoid re-scanning the events."""
+    sid = {s["name"]: i for i, s in enumerate(trace.streams)}
+
+    def tid_of(rank: int) -> int:
+        # the frame points live on the ptcomm progress-thread streams
+        for name, i in sid.items():
+            if name.startswith(f"r{rank}:ptcomm-"):
+                return i
+        return 0
+
+    if flows is None:
+        flows = act_flows(trace)
+    out: List[Dict[str, Any]] = []
+    for src, dst, seq, t_tx, t_rx in flows["pairs"]:
+        fid = f"act:{src}>{dst}#{seq}"
+        out.append({"name": "xrank-activate", "cat": "ptcomm", "ph": "s",
+                    "id": fid, "ts": (t_tx - trace.t0) * 1e6, "pid": 0,
+                    "tid": tid_of(src)})
+        out.append({"name": "xrank-activate", "cat": "ptcomm", "ph": "f",
+                    "bp": "e", "id": fid, "ts": (t_rx - trace.t0) * 1e6,
+                    "pid": 0, "tid": tid_of(dst)})
+    return out
+
+
+def merge_to_chrome(paths: List[str]
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """One-call merge recipe — THE home of the merge+flow invariant
+    (the CLI and the ci gate both call it): N per-rank .pbp files ->
+    ``(chrome_json_with_flow_arrows, act_flows_result)``."""
+    merged = merge_traces(paths)
+    flows = act_flows(merged)
+    out = to_chrome_trace(merged)
+    out["traceEvents"].extend(flow_chrome_events(merged, flows))
+    return out, flows
+
+
 def comm_events(trace: TraceData) -> List[Dict[str, Any]]:
     """Extract typed comm-stream events (``comm::*`` keywords) with their
     decoded src/dst/bytes info blobs (ref: the comm-thread stream written
@@ -286,13 +449,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not argv:
         print("usage: trace_reader <trace.pbp|archive.ptf2> "
               "[--ctf out.json] [--csv out.csv] [--svg out.svg]\n"
-              "       trace_reader --check-comms <rank0.pbp> <rank1.pbp> ...",
+              "       trace_reader --check-comms <rank0.pbp> <rank1.pbp> ...\n"
+              "       trace_reader --merge out.json <rank0.pbp> "
+              "<rank1.pbp> ...  (clock-aligned Perfetto timeline with "
+              "cross-rank flow arrows)",
               file=sys.stderr)
         return 2
     if argv[0] == "--check-comms":
         summary = check_comms(argv[1:])
         print(json.dumps(summary))
         return 1 if summary["errors"] else 0
+    if argv[0] == "--merge":
+        out_path, paths = argv[1], argv[2:]
+        ctf, flows = merge_to_chrome(paths)
+        with open(out_path, "w") as f:
+            json.dump(ctf, f)
+        print(f"merged {len(paths)} rank traces -> {out_path}: "
+              f"{len(flows['pairs'])} cross-rank flow pairs, "
+              f"{len(flows['unmatched_tx'])} unmatched tx, "
+              f"{len(flows['unmatched_rx'])} unmatched rx")
+        return 1 if flows["unmatched_tx"] or flows["unmatched_rx"] else 0
     trace = read_trace(argv[0])
     print(f"trace: {len(trace.dictionary)} keywords, "
           f"{len(trace.streams)} streams, "

@@ -105,6 +105,7 @@ def install_native_counters() -> None:
     from ..dsl import dtd as _dtd
     from ..dsl.ptg import compiler as _ptg
     from . import native_trace as _nt
+    from .hist import install_hist_counters
 
     def _sampler(stats, key):
         return lambda: stats[key]
@@ -121,6 +122,8 @@ def install_native_counters() -> None:
     counters.register(TRACE_EVENTS_DROPPED, sampler=_nt.total_dropped)
     counters.register(TRACE_EVENTS_NATIVE, sampler=_nt.total_landed)
     counters.register(PTEXEC_SLOTS_RETIRED)   # accumulator: lane finalize adds
+    # latency percentiles (<kind>.hist.<name>.p99_us etc. — ISSUE 8)
+    install_hist_counters()
 
 
 def install_scheduler_counters(context) -> None:

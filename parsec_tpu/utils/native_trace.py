@@ -62,7 +62,12 @@ NATIVE_KEYWORDS: Dict[str, Dict[int, str]] = {
     # measurable in the same Perfetto view as the execution lanes
     "ptcomm": {1: "ptcomm::act_tx", 2: "ptcomm::act_rx",
                3: "ptcomm::data_tx", 4: "ptcomm::data_rx",
-               5: "ptcomm::rdv_get", 6: "ptcomm::rdv_rep"},
+               5: "ptcomm::rdv_get", 6: "ptcomm::rdv_rep",
+               # flow identity points (ISSUE 8): id = (peer << 40) | seq
+               # of one K_ACTS frame; merge_traces pairs frame_tx on the
+               # sender with frame_rx on the receiver into Perfetto flow
+               # arrows, one causal edge per cross-rank activation frame
+               7: "ptcomm::frame_tx", 8: "ptcomm::frame_rx"},
 }
 
 #: live bridges, for the process-wide drop/landed samplers

@@ -1,0 +1,522 @@
+"""Cross-rank observability plane tests (ISSUE 8).
+
+Four layers, mirroring how the plane is built:
+
+* **histogram bucket math** — the Python mirror of pthist.h (boundaries,
+  monotonicity, percentile summarization against numpy) and the native
+  recording contract (exact counts, including under concurrent workers);
+* **metrics endpoint** — /metrics //health //histograms serve the
+  unified registry + latency percentiles over TCP and UDS, and shut
+  down cleanly (no leaked thread/socket across tests);
+* **live_view** — decimate-in-half instead of silently dropping samples,
+  and the cross-process endpoint-polling mode;
+* **multi-rank** — synthetic and real 2-OS-rank merges: clock-offset
+  metadata rebases per-rank traces onto rank 0's clock, every cross-rank
+  activation frame pairs into a send->ingest flow (zero unmatched,
+  causally ordered), and the fini counter aggregation rolls up the
+  native ``ptcomm.*`` wire counters (lane-aware).
+
+Program functions live at module top level so multiprocessing spawn can
+import them (the test_tcp_distributed.py pattern).
+"""
+
+import functools
+import json
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import native as native_mod
+from parsec_tpu.utils import hist as H
+from parsec_tpu.utils import mca
+
+_ptexec = native_mod.load_ptexec()
+_ptdtd = native_mod.load_ptdtd()
+_ptcomm = native_mod.load_ptcomm()
+
+pytestmark = pytest.mark.skipif(
+    _ptexec is None or _ptdtd is None or _ptcomm is None,
+    reason="native extensions unavailable")
+
+
+# ------------------------------------------------------- bucket math units
+
+def test_hist_constants_match_native():
+    assert _ptexec.HIST_BUCKETS == H.NBUCKETS
+    assert _ptexec.HIST_SUB_BITS == H.SUB_BITS
+    assert _ptdtd.HIST_BUCKETS == H.NBUCKETS
+    assert _ptcomm.HIST_BUCKETS == H.NBUCKETS
+
+
+def test_bucket_boundaries():
+    """Every value lands in a bucket whose [lo, lo+width) contains it;
+    indices are monotone in the value; small values are exact."""
+    last = -1
+    for v in [0, 1, 2, 7, 8, 9, 15, 16, 17, 31, 32, 100, 1000, 4095, 4096,
+              10**6, 10**9, 2**40, 2**62]:
+        i = H.bucket_index(v)
+        assert i >= last, (v, i, last)
+        last = i
+        lo = H.bucket_lo(i)
+        assert lo <= v < lo + H.bucket_width(i), (v, i, lo)
+    for v in range(H.SUBS):
+        assert H.bucket_index(v) == v and H.bucket_lo(v) == v
+    # continuity: each bucket's end is the next bucket's start
+    for i in range(H.NBUCKETS - 1):
+        assert H.bucket_lo(i) + H.bucket_width(i) == H.bucket_lo(i + 1), i
+    # negative values clamp, never raise
+    assert H.bucket_index(-5) == 0
+
+
+def test_bucket_index_matches_native_recording():
+    """Bucketize known values through a real Graph hist: a 1-task graph's
+    exec_ns sample must land in SOME bucket and the Python decode must
+    see exactly the counts the C side bumped."""
+    g = _ptexec.Graph([0], [0, 0], [])
+    g.hist_enable()
+    g.run(None, 1, 0)
+    snap = g.hist_snapshot()
+    count, sum_ns, raw = snap["exec_ns"]
+    buckets = H.decode_buckets(raw)
+    assert count == 1 and sum(buckets) == 1
+    i = buckets.index(1)
+    assert H.bucket_lo(i) <= max(sum_ns, 0) < H.bucket_lo(i) + \
+        H.bucket_width(i) or sum_ns < H.SUBS
+
+
+def test_percentile_summarization_vs_numpy():
+    """p50/p99/p999 from the bucketized distribution stay within one
+    bucket width (~12.5% relative) of numpy's exact percentiles."""
+    rng = np.random.default_rng(7)
+    vals = (rng.lognormal(mean=8.0, sigma=1.2, size=20000)).astype(np.int64)
+    buckets = [0] * H.NBUCKETS
+    for v in vals:
+        buckets[H.bucket_index(int(v))] += 1
+    for q in (0.5, 0.99, 0.999):
+        exact = float(np.quantile(vals, q))
+        est = H.percentile(buckets, q)
+        assert abs(est - exact) <= 0.15 * exact + 1, (q, est, exact)
+    s = H.summarize(buckets, len(vals), int(vals.sum()))
+    assert s["count"] == len(vals)
+    assert abs(s["mean_us"] * 1e3 - vals.mean()) < 1.0
+    # empty histogram degrades to zeros, never raises
+    z = H.summarize([0] * H.NBUCKETS, 0, 0)
+    assert z["p99_us"] == 0.0 and z["count"] == 0
+
+
+def test_graph_hist_concurrent_bumps_sum_exactly():
+    """Two workers draining one graph: exec counts sum to exactly n and
+    the sampled ready-wait counts exactly the 1-in-8 ids (no lost or
+    double bumps from the relaxed atomics)."""
+    n = 4096
+    # NT independent 2-chains: plenty of parallel work for 2 threads
+    goals = [0 if i < n // 2 else 1 for i in range(n)]
+    succ_off, succs = [], []
+    for i in range(n):
+        succ_off.append(len(succs))
+        if i < n // 2:
+            succs.append(n // 2 + i)
+    succ_off.append(len(succs))
+    g = _ptexec.Graph(goals, succ_off, succs)
+    g.hist_enable()
+    errs = []
+
+    def worker():
+        try:
+            while not g.done():
+                g.run(None, 64, 512)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs and g.done()
+    snap = g.hist_snapshot()
+    count, _, raw = snap["exec_ns"]
+    assert count == n
+    assert sum(H.decode_buckets(raw)) == n
+    rcount, _, rraw = snap["ready_wait_ns"]
+    expect = len([i for i in range(n) if i % 8 == 0])
+    assert rcount == expect, (rcount, expect)
+    assert sum(H.decode_buckets(rraw)) == expect
+
+
+def test_dtd_engine_hist_counts():
+    eng = _ptdtd.Engine()
+    eng.hist_enable()
+    t0 = eng.tile()
+    cls = eng.register_class(lambda args: None, [0], [1])   # READ-only
+    eng.insert_many([(cls, None, t0, 1)] * 64)
+    nexec, _ = eng.drain_ready(16, 4096)
+    assert nexec == 64
+    snap = eng.hist_snapshot()
+    assert snap["exec_ns"][0] == 64
+    assert snap["ready_wait_ns"][0] == 64
+    assert sum(H.decode_buckets(snap["exec_ns"][2])) == 64
+
+
+def test_hist_registry_accumulates_across_detach():
+    reg = H.NativeHistograms()
+    g = _ptexec.Graph([0] * 8, [0] * 9, [])
+    assert reg.attach("ptexec", g)
+    assert reg.attach("ptexec", g)          # idempotent
+    g.run(None, 8, 0)
+    live = reg.snapshot()["ptexec.exec_ns"]["count"]
+    assert live == 8
+    reg.detach(g)
+    del g
+    after = reg.snapshot()["ptexec.exec_ns"]
+    assert after["count"] == 8              # folded, not lost
+    s = reg.summaries()
+    assert s["ptexec.exec_ns"]["count"] == 8
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+# ------------------------------------------------------- metrics endpoint
+
+def _chain_prog():
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    return compile_ptg(
+        "%global NT\n%global DEPTH\n"
+        "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. DEPTH-1\n"
+        "  CTL S <- (l > 0) ? S T(i, l-1)\n"
+        "        -> (l < DEPTH-1) ? S T(i, l+1)\nBODY\n  pass\nEND\n",
+        "obs-test-chain")
+
+
+def test_metrics_server_serves_and_shuts_down_tcp():
+    from parsec_tpu.tools.metrics_server import MetricsServer, fetch
+    from parsec_tpu.utils.counters import counters
+
+    srv = MetricsServer(rank=0, nb_ranks=1, port=0).start()
+    counters.register("test.obs_served")
+    counters.add("test.obs_served", 7)
+    counters.register("test.obs_nan", sampler=lambda: float("nan"))
+    h = fetch(srv.endpoint, "/health")
+    assert h["ok"] and h["rank"] == 0 and h["pid"] == os.getpid()
+    m = fetch(srv.endpoint, "/metrics")
+    assert m["counters"]["test.obs_served"] == 7
+    # strict RFC-8259 body: a NaN sampler serializes as null, never the
+    # bare `NaN` token (curl | jq / JSON.parse must parse the scrape)
+    assert m["counters"]["test.obs_nan"] is None
+    assert "percentiles" in m and "ts" in m
+    raw = fetch(srv.endpoint, "/histograms")
+    assert "histograms" in raw
+    with pytest.raises(RuntimeError):
+        fetch(srv.endpoint, "/nope")
+    srv.stop()
+    # clean teardown: socket closed, no listener left behind
+    with pytest.raises((OSError, RuntimeError)):
+        fetch(srv.endpoint, "/health", timeout=0.5)
+    assert srv._thread is None
+
+
+def test_metrics_server_uds(tmp_path):
+    from parsec_tpu.tools.metrics_server import MetricsServer, fetch
+
+    path = str(tmp_path / "metrics.sock")
+    srv = MetricsServer(rank=3, nb_ranks=4, uds=path).start()
+    assert srv.endpoint == f"unix:{path}.r3"
+    m = fetch(srv.endpoint)
+    assert m["rank"] == 3 and m["nb_ranks"] == 4
+    srv.stop()
+    assert not os.path.exists(f"{path}.r3")   # inode unlinked
+
+
+def test_metrics_endpoint_from_context_lifecycle():
+    """--mca metrics_port wires the endpoint into Context init/fini and
+    implies histograms: a lane run is scrapeable with live percentiles,
+    and fini tears the endpoint down (no leak across contexts)."""
+    import socket as _socket
+
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.tools.metrics_server import fetch
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    mca.set("metrics_port", port)
+    try:
+        ctx = Context(nb_cores=1)
+        assert ctx.metrics is not None and ctx._hist_on
+        tp = _chain_prog().instantiate(
+            ctx, globals={"NT": 16, "DEPTH": 8}, collections={})
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        m = fetch(ctx.metrics.endpoint)
+        assert m["counters"]["ptexec.pools_engaged"] >= 1
+        assert m["percentiles"]["ptexec.exec_ns"]["count"] >= 128
+        assert m["counters"]["ptexec.hist.exec_ns.count"] >= 128
+        ep = ctx.metrics.endpoint
+        ctx.fini()
+        assert ctx.metrics is None
+        with pytest.raises((OSError, RuntimeError)):
+            fetch(ep, "/health", timeout=0.5)
+    finally:
+        mca.params.unset("metrics_port")
+
+
+# ------------------------------------------------------------- live_view
+
+def test_live_view_decimates_instead_of_dropping():
+    from parsec_tpu.tools.live_view import LiveCounterView
+    from parsec_tpu.utils.counters import CounterRegistry
+
+    reg = CounterRegistry()
+    reg.register("x")
+    view = LiveCounterView(registry=reg, max_samples=16)
+    for i in range(100):
+        reg.set("x", i)
+        view.sample()
+    st = view.stats()
+    assert st["samples"] <= 16
+    assert st["samples_dropped"] > 0 and st["decimations"] >= 1
+    # the series still spans the WHOLE run: first and latest values kept
+    xs = view.series["x"]
+    assert xs[-1] == 99.0 and xs[0] <= 10.0
+    assert len(xs) == len(view.times)
+
+
+def test_live_view_cross_process_endpoints():
+    from parsec_tpu.tools.live_view import LiveCounterView
+    from parsec_tpu.tools.metrics_server import MetricsServer
+    from parsec_tpu.utils.counters import counters
+
+    srv = MetricsServer(rank=0, nb_ranks=1, port=0).start()
+    try:
+        counters.register("test.lv_remote")
+        counters.add("test.lv_remote", 5)
+        view = LiveCounterView(endpoints=[srv.endpoint])
+        view.sample()
+        assert view.series["test.lv_remote"][-1] == 5.0
+        # a dead endpoint counts an error but does not break sampling
+        bad = LiveCounterView(endpoints=["http://127.0.0.1:1"])
+        bad.sample()
+        assert bad.poll_errors == 1 and len(bad.times) == 1
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------- merge (synthetic unit)
+
+def _mk_rank_trace(tmp_path, rank, offset_ns, events):
+    """A synthetic per-rank trace: meta::clock + ptcomm frame points."""
+    from parsec_tpu.utils.trace import EVENT_FLAG_POINT, Profiling
+
+    prof = Profiling()
+    start, _ = prof.add_dictionary_keyword(
+        "meta::clock",
+        info_desc="rank{i};peer{i};offset_ns{q};rtt_ns{q};ok{i}")
+    s = prof.stream(f"clock(rank {rank})")
+    s.events.append((start, 0, 0, 1000.0, EVENT_FLAG_POINT,
+                     prof.pack_info("meta::clock", rank=rank, peer=0,
+                                    offset_ns=offset_ns, rtt_ns=50_000,
+                                    ok=1)))
+    tx, _ = prof.add_dictionary_keyword("ptcomm::frame_tx")
+    rx, _ = prof.add_dictionary_keyword("ptcomm::frame_rx")
+    comm = prof.stream("ptcomm-w0")
+    for kind, peer, seq, t in events:
+        key = tx if kind == "tx" else rx
+        comm.events.append((key, (peer << 40) | seq, 0, t,
+                            EVENT_FLAG_POINT, b""))
+    path = str(tmp_path / f"rank{rank}.pbp")
+    prof.dump(path)
+    return path
+
+
+def test_merge_traces_rebases_and_pairs(tmp_path):
+    from parsec_tpu.tools import trace_reader as tr
+
+    # rank 1's clock runs 1 ms BEHIND rank 0 (offset = -1e6 ns): its raw
+    # rx stamps land BEFORE the matching tx; the rebase must fix it
+    off = -1_000_000
+    p0 = _mk_rank_trace(tmp_path, 0, 0, [
+        ("tx", 1, 1, 10.000), ("tx", 1, 2, 10.010), ("rx", 1, 1, 10.020)])
+    p1 = _mk_rank_trace(tmp_path, 1, off, [
+        ("rx", 0, 1, 10.0005 + off * 1e-9),
+        ("rx", 0, 2, 10.0105 + off * 1e-9),
+        ("tx", 0, 1, 10.0150 + off * 1e-9)])
+    merged = tr.merge_traces([p0, p1])
+    meta0 = tr.clock_meta(tr.read_pbp(p0))
+    assert meta0["rank"] == 0 and meta0["offset_ns"] == 0
+    names = [s["name"] for s in merged.streams]
+    assert "r0:ptcomm-w0" in names and "r1:ptcomm-w0" in names
+    flows = tr.act_flows(merged)
+    assert not flows["unmatched_tx"] and not flows["unmatched_rx"]
+    assert len(flows["pairs"]) == 3
+    for src, dst, seq, t_tx, t_rx in flows["pairs"]:
+        assert t_rx > t_tx, (src, dst, seq, t_tx, t_rx)  # clock-aligned
+    # an UNREBASED merge shows the skew (sanity that rebase does work)
+    rawm = tr.merge_traces([p0, p1], rebase=False)
+    raw_pairs = tr.act_flows(rawm)["pairs"]
+    assert any(t_rx < t_tx for _, _, _, t_tx, t_rx in raw_pairs)
+    # chrome export round-trips with flow records attached
+    ctf = tr.to_chrome_trace(merged)
+    ctf["traceEvents"].extend(tr.flow_chrome_events(merged))
+    blob = json.loads(json.dumps(ctf))
+    assert len([e for e in blob["traceEvents"]
+                if e.get("ph") in ("s", "f")]) == 6
+
+
+def test_merge_unmatched_reported(tmp_path):
+    from parsec_tpu.tools import trace_reader as tr
+
+    p0 = _mk_rank_trace(tmp_path, 0, 0, [("tx", 1, 1, 1.0),
+                                         ("tx", 1, 2, 2.0)])
+    p1 = _mk_rank_trace(tmp_path, 1, 0, [("rx", 0, 1, 1.5)])
+    flows = tr.act_flows(tr.merge_traces([p0, p1]))
+    assert len(flows["pairs"]) == 1
+    assert flows["unmatched_tx"] == [(0, 1, 2)]
+    assert not flows["unmatched_rx"]
+
+
+def test_merge_cli(tmp_path):
+    from parsec_tpu.tools import trace_reader as tr
+
+    p0 = _mk_rank_trace(tmp_path, 0, 0, [("tx", 1, 1, 1.0)])
+    p1 = _mk_rank_trace(tmp_path, 1, 0, [("rx", 0, 1, 1.5)])
+    out = str(tmp_path / "merged.json")
+    assert tr.main(["--merge", out, p0, p1]) == 0
+    blob = json.load(open(out))
+    assert any(e.get("ph") == "s" for e in blob["traceEvents"])
+    # an unmatched merge exits nonzero (the ci gate contract)
+    p2 = _mk_rank_trace(tmp_path, 0, 0, [("tx", 1, 9, 1.0)])
+    assert tr.main(["--merge", out, p2, p1]) == 1
+
+
+def test_incomplete_clock_stamp_does_not_latch(tmp_path):
+    """An ok=0 stamp (dump raced the ladder) must not block the real
+    estimate from landing later, and clock_meta prefers the ok=1 record
+    over any earlier incomplete one."""
+    from parsec_tpu.comm.threads import ThreadFabric, ThreadsCE
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.tools import trace_reader as tr
+    from parsec_tpu.utils.trace import Profiling
+
+    fabric = ThreadFabric(2)
+    ce = ThreadsCE(fabric, 1)          # rank 1: no trivial-done shortcut
+    ctx = Context(nb_cores=1, my_rank=1, nb_ranks=2)
+    ctx.profiling = Profiling()
+    eng = RemoteDepEngine(ctx, ce)
+    assert not eng._clk_done
+    eng.stamp_clock_meta()             # incomplete: ok=0, must not latch
+    assert not getattr(ctx.profiling, "_clk_stamped", False)
+    with eng._clk_lock:                # ladder completes later
+        eng._clk_offset_ns, eng._clk_rtt_ns = 1234, 99
+        eng._clk_done = True
+    eng.stamp_clock_meta()
+    assert ctx.profiling._clk_stamped
+    eng.stamp_clock_meta()             # latched: no third record
+    path = str(tmp_path / "latch.pbp")
+    ctx.profiling.dump(path)
+    trace = tr.read_pbp(path)
+    meta = tr.clock_meta(trace)
+    assert meta["ok"] == 1 and meta["offset_ns"] == 1234
+    # re-stamps reuse ONE stream — no duplicate clock(rank N) rows
+    assert len([s for s in trace.streams
+                if s["name"].startswith("clock(")]) == 1
+    ctx.comm = None                    # the fake engine has no real peers
+    ctx.fini()
+
+
+# --------------------------------------------------- 2-OS-rank end-to-end
+
+def _obs_program(rank, ce, trace_dir=None):
+    """Traced+histogrammed cross-rank chain: returns clock estimate,
+    per-rank trace path, and the rank-0 lane-aware counter rollup."""
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001
+        pass
+    from parsec_tpu.comm.remote_dep import RemoteDepEngine
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.data.matrix import TwoDimBlockCyclic
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    from parsec_tpu.utils import mca as _mca
+    from parsec_tpu.utils.trace import Profiling
+
+    nt, depth = 4, 8
+    _mca.set("hist_enabled", True)
+    ctx = Context(nb_cores=1, my_rank=rank, nb_ranks=ce.nb_ranks)
+    ctx.profiling = Profiling()
+    eng = RemoteDepEngine(ctx, ce)
+    A = TwoDimBlockCyclic("descA", depth, nt, 1, 1, P=2, Q=1,
+                          nodes=2, myrank=rank)
+    src = ("%global NT\n%global DEPTH\n%global descA\n"
+           "T(i, l)\n  i = 0 .. NT-1\n  l = 0 .. DEPTH-1\n"
+           "  : descA(l, i)\n"
+           "  CTL S <- (l > 0) ? S T(i, l-1)\n"
+           "        -> (l < DEPTH-1) ? S T(i, l+1)\nBODY\n  pass\nEND\n")
+    prog = compile_ptg(src, "obs-test-2rank")
+    ce.sync()
+    tp = prog.instantiate(ctx, globals={"NT": nt, "DEPTH": depth},
+                          collections={"descA": A}, name="obs-test-2rank")
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=300)
+    ce.sync()
+    clock_ok = eng.clock_sync_wait(timeout=30.0)
+    ce.sync()
+    table = eng.aggregate_counters(timeout=30.0)
+    engaged = tp._ptexec_state is not None and \
+        tp._ptexec_state.get("pool_id") is not None
+    stats = ctx.comm.native.comm.stats() if ctx.comm.native else None
+    ce.sync()
+    ctx.fini()
+    pbp = os.path.join(trace_dir, f"rank{rank}.pbp")
+    ctx.profiling.dump(pbp)
+    ce.fini()
+    return {"rank": rank, "engaged": engaged, "clock_ok": clock_ok,
+            "offset_ns": eng._clk_offset_ns, "rtt_ns": eng._clk_rtt_ns,
+            "trace": pbp, "table": table,
+            "frames_tx": stats["act_frames_tx"] if stats else 0}
+
+
+def test_two_rank_clock_merge_and_aggregation(tmp_path):
+    """The acceptance shape: same-host 2-rank run -> bounded clock
+    offset, merged clock-aligned timeline with every activation frame
+    paired and causally ordered, and a lane-aware fini rollup carrying
+    nonzero ptcomm wire counters."""
+    from parsec_tpu.comm.tcp import run_distributed_procs
+    from parsec_tpu.tools import trace_reader as tr
+
+    res = run_distributed_procs(
+        2, functools.partial(_obs_program, trace_dir=str(tmp_path)),
+        timeout=300)
+    for r in res:
+        assert r["engaged"], r
+        assert r["clock_ok"], r
+        # same host, same CLOCK_MONOTONIC: the estimate must be tiny;
+        # its error bound is min-RTT/2, so allow generous slack for a
+        # loaded container
+        assert abs(r["offset_ns"]) < 50_000_000, r["offset_ns"]
+        assert r["rtt_ns"] >= 0
+    # lane-aware aggregation: rank 0 merged both ranks incl. the native
+    # wire counters the interpreted path never saw
+    table = res[0]["table"]
+    assert res[1]["table"] is None
+    assert table["sum"].get("ptcomm.acts_tx", 0) > 0, \
+        sorted(k for k in table["sum"] if k.startswith("ptcomm"))
+    assert table["sum"].get("ptcomm.frame_errors", -1) == 0
+    assert table["sum"].get("ptexec.hist.exec_ns.count", 0) > 0
+    # merged timeline: all frames pair, rebased send precedes ingest
+    merged = tr.merge_traces([r["trace"] for r in res])
+    metas = [tr.clock_meta(tr.read_pbp(r["trace"])) for r in res]
+    assert {int(m["rank"]) for m in metas} == {0, 1}
+    flows = tr.act_flows(merged)
+    assert not flows["unmatched_tx"], flows["unmatched_tx"][:5]
+    assert not flows["unmatched_rx"], flows["unmatched_rx"][:5]
+    assert len(flows["pairs"]) == sum(r["frames_tx"] for r in res)
+    assert len(flows["pairs"]) > 0
+    for src, dst, seq, t_tx, t_rx in flows["pairs"]:
+        assert t_rx >= t_tx - 1e-3, (src, dst, seq, t_tx, t_rx)
