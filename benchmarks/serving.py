@@ -1,0 +1,443 @@
+"""Serving-mode benchmark: sustained multi-pool ingest at bounded p99.
+
+The ROADMAP's "millions of users" shape (ISSUE 9): N inserter threads
+feed M concurrent DTD taskpools at STEADY STATE — the metric is sustained
+inserts/s at a BOUNDED p99 task latency (from the PR 8 native
+histograms), not batch wall-time. The scheduler plane (ptsched) supplies
+what the measurement exercises: per-pool QoS weights arbitrate the drain,
+admission windows bound the ready backlog (so p99 cannot grow without
+bound — a runaway inserter blocks instead of queueing), and the per-pool
+served counters make the weighted-share check exact.
+
+Legs:
+
+* ``run_serving`` — M pools x N threads for ``seconds``; reports
+  sustained inserts/s, task-latency p50/p99 (``ptdtd.exec_ns``), plane
+  queue-wait p99 (``sched.queue_ns``), p99 drift between the first and
+  second half of the run (bounded-latency evidence), per-pool served
+  shares vs configured weights.
+* ``--ci-gate`` — small multi-pool engagement smoke for ci.sh: plane
+  engaged for every eligible pool (zero fallbacks), per-pool served
+  counters nonzero, weighted shares sane, admission stalls observed when
+  a window is set. Engagement, not throughput: a noisy host cannot flake
+  it.
+
+bench.py keys (degrade-and-continue like the 2-rank comm keys):
+``serving_sustained_inserts_per_sec_native``,
+``serving_task_p99_us_native``, ``serving_weighted_share_err_pct``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _body(x):
+    return None
+
+
+def _mk_work_body(work: int):
+    """A body burning ~``work`` scalar ops: the weighted-fairness legs
+    need the DRAIN to be the bottleneck (weights only bind while every
+    pool is backlogged); trivial bodies leave the run ingest-limited and
+    service tracks arrival instead of weight."""
+    if work <= 0:
+        return _body
+    a = np.arange(float(max(8, work)))
+
+    def _burn(x):
+        float((a * a).sum())
+        return None
+    return _burn
+
+
+def run_serving(npools: int = 8, nthreads: int | None = None,
+                seconds: float = 3.0, weights=None, window: int = 4096,
+                nb_cores: int = 2, tiles_per_pool: int = 32,
+                hist: bool = True, work: int = 0) -> dict:
+    """One steady-state serving run; returns the measurement dict.
+
+    Every pool gets one dedicated inserter thread by default (the
+    serving-tier shape: one client stream per tenant); ``weights[i]`` is
+    pool i's QoS weight. The admission window keeps each pool's in-flight
+    count bounded, so the ready plane — and with it the task-latency p99
+    — cannot grow monotonically no matter how hot the inserters run."""
+    from parsec_tpu import Context
+    from parsec_tpu.dsl.dtd import READ, DTDTaskpool
+    from parsec_tpu.utils import mca
+    from parsec_tpu.utils.hist import histograms, summarize
+
+    if weights is None:
+        weights = [1] * npools
+    assert len(weights) == npools
+    nthreads = npools if nthreads is None else nthreads
+    if hist:
+        mca.set("hist_enabled", True)
+    histograms.reset()
+    ctx = Context(nb_cores=nb_cores)
+    plane = ctx.sched_plane
+    try:
+        pools = []
+        for i in range(npools):
+            tp = DTDTaskpool(ctx, f"serve{i}")
+            tp.qos_weight = weights[i]
+            tp.admission_window = window
+            tiles = [tp.tile_new((2, 2)) for _ in range(tiles_per_pool)]
+            pools.append((tp, tiles))
+        inserted = [0] * nthreads     # one slot per THREAD: += on a
+        stop = threading.Event()      # shared pool slot would race when
+        barrier = threading.Barrier(nthreads + 1)   # nthreads > npools
+
+        body = _mk_work_body(work)
+
+        def _inserter(k: int) -> None:
+            tp, tiles = pools[k % npools]
+            barrier.wait()
+            n = 0
+            while not stop.is_set():
+                # READ on writer-less tiles = independent tasks (the EP
+                # serving shape); the admission window is the only brake
+                tp.insert_task(body, (tiles[n % tiles_per_pool], READ),
+                               jit=False, name="S")
+                n += 1
+            inserted[k] = n
+
+        threads = [threading.Thread(target=_inserter, args=(k,),
+                                    name=f"serve-ins-{k}")
+                   for k in range(nthreads)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        # mid-run snapshots: per-pool served (weighted-share window) and
+        # the latency buckets (p99-drift window) — both taken while every
+        # pool is still backlogged, which is what "steady state" means
+        time.sleep(seconds / 2)
+        served_mid = {}
+        if plane is not None:
+            for tp, _ in pools:
+                if tp._sched_pool is not None:
+                    served_mid[tp.name] = \
+                        plane.pool_stats(tp._sched_pool)["served"]
+        hist_mid = histograms.snapshot()
+        time.sleep(seconds / 2)
+        served_end = {}
+        if plane is not None:
+            for tp, _ in pools:
+                if tp._sched_pool is not None:
+                    served_end[tp.name] = \
+                        plane.pool_stats(tp._sched_pool)["served"]
+        hist_end = histograms.snapshot()
+        stop.set()
+        for t in threads:
+            t.join()
+        steady_s = time.perf_counter() - t0
+        for tp, _ in pools:
+            tp.wait(timeout=120)
+            tp.close()
+        ctx.wait(timeout=120)
+
+        total = sum(inserted)
+        out = {
+            "pools": npools, "threads": nthreads, "window": window,
+            "weights": list(weights), "nb_cores": nb_cores, "work": work,
+            "seconds": round(steady_s, 3),
+            "inserted": total,
+            "sustained_inserts_per_sec": round(total / steady_s),
+        }
+        # task-latency percentiles over the whole run + the drift check:
+        # p99 of the SECOND half alone vs the first half — a backlog
+        # growing without bound shows up as monotonic p99 growth, which
+        # the admission window is there to prevent
+        def _p99(snap, key):
+            d = snap.get(key)
+            if d is None or not d["count"]:
+                return None
+            s = summarize(d["buckets"], d["count"], d["sum_ns"])
+            return s
+        exec_all = _p99(hist_end, "ptdtd.exec_ns")
+        if exec_all:
+            out["task_p50_us"] = round(exec_all["p50_us"], 3)
+            out["task_p99_us"] = round(exec_all["p99_us"], 3)
+        q_all = _p99(hist_end, "sched.queue_ns")
+        if q_all:
+            out["queue_wait_p99_us"] = round(q_all["p99_us"], 3)
+        mid = hist_mid.get("ptdtd.exec_ns")
+        end = hist_end.get("ptdtd.exec_ns")
+        if mid and end and end["count"] > mid["count"]:
+            half = [e - m for e, m in zip(end["buckets"], mid["buckets"])]
+            h2 = summarize(half, end["count"] - mid["count"],
+                           end["sum_ns"] - mid["sum_ns"])
+            h1 = summarize(mid["buckets"], mid["count"], mid["sum_ns"])
+            out["task_p99_us_first_half"] = round(h1["p99_us"], 3)
+            out["task_p99_us_second_half"] = round(h2["p99_us"], 3)
+        # weighted shares over the mid->end window (every pool backlogged)
+        if served_mid and served_end:
+            deltas = {}
+            for (tp, _), w in zip(pools, weights):
+                if tp.name in served_mid and tp.name in served_end:
+                    deltas[tp.name] = (
+                        served_end[tp.name] - served_mid[tp.name], w)
+            tot_served = sum(d for d, _ in deltas.values())
+            tot_w = sum(w for _, w in deltas.values())
+            if tot_served > 0 and tot_w > 0:
+                errs = {}
+                for name, (d, w) in deltas.items():
+                    share, target = d / tot_served, w / tot_w
+                    errs[name] = 100.0 * (share - target) / target
+                out["per_pool_served"] = {n: d for n, (d, _) in
+                                          deltas.items()}
+                out["weighted_share_err_pct"] = {
+                    n: round(e, 1) for n, e in errs.items()}
+                out["weighted_share_err_max_pct"] = round(
+                    max(abs(e) for e in errs.values()), 1)
+        if plane is not None:
+            out["plane"] = plane.stats()
+        from parsec_tpu.core.sched_plane import SCHED_STATS
+        out["sched_stats"] = SCHED_STATS.snapshot()
+        return out
+    finally:
+        ctx.fini(timeout=60)
+        if hist:
+            mca.params.unset("hist_enabled")
+
+
+def run_weighted(npools: int = 8, weights=None, seconds: float = 3.0,
+                 work: int = 20000, window: int = 1024,
+                 nb_cores: int = 2) -> dict:
+    """The weighted-fairness leg: drain-limited bodies, ONE round-robin
+    feeder keeping every pool topped up to its window. Per-pool inserter
+    threads (the throughput leg's shape) make share measurements
+    GIL-scheduling-bound on small hosts — a descheduled inserter starves
+    its own pool for whole switch intervals and service collapses to
+    arrival. A single feeder decouples arrival from thread scheduling,
+    so the measured shares isolate what this leg is about: the plane's
+    weighted-DRR drain arbitration."""
+    from parsec_tpu import Context
+    from parsec_tpu.dsl.dtd import READ, DTDTaskpool
+
+    if weights is None:
+        weights = [1] * npools
+    assert len(weights) == npools
+    ctx = Context(nb_cores=nb_cores)
+    plane = ctx.sched_plane
+    body = _mk_work_body(work)
+    try:
+        pools = []
+        for i in range(npools):
+            tp = DTDTaskpool(ctx, f"wserve{i}")
+            tp.qos_weight = weights[i]
+            pools.append((tp, [tp.tile_new((2, 2)) for _ in range(8)]))
+        ctx.start()
+        deadline = time.perf_counter() + seconds
+        mid_t = time.perf_counter() + seconds / 2
+        served_mid = served_end = None
+        counts = [0] * npools
+
+        def _snapshot():
+            return {tp.name: plane.pool_stats(tp._sched_pool)["served"]
+                    for tp, _ in pools if tp._sched_pool is not None} \
+                if plane is not None else {}
+
+        warm = time.perf_counter() + min(0.5, seconds / 4)
+        warmed = False
+        while time.perf_counter() < deadline:
+            fed = False
+            for k, (tp, tiles) in enumerate(pools):
+                h = tp._sched_pool
+                q = plane.plane.queued(h) if (plane is not None and
+                                              h is not None) else 0
+                # top up to the window (never past it: the feeder must
+                # not trip its own admission stall)
+                need = window - q if h is not None else 64
+                if need >= 64:
+                    for _ in range(min(need, 256)):
+                        tp.insert_task(body, (tiles[counts[k] % 8], READ),
+                                       jit=False, name="W")
+                        counts[k] += 1
+                    fed = True
+            if not warmed and time.perf_counter() >= warm:
+                warmed = True        # all pools backlogged: open the
+                served_mid = _snapshot()   # measurement window
+            if not fed:
+                time.sleep(0.002)    # everyone full: let the drain work
+        served_end = _snapshot()
+        for tp, _ in pools:
+            tp.wait(timeout=120)
+            tp.close()
+        ctx.wait(timeout=120)
+        out = {"pools": npools, "weights": list(weights), "work": work,
+               "window": window, "inserted": sum(counts)}
+        if served_mid and served_end:
+            deltas = {}
+            for (tp, _), w in zip(pools, weights):
+                if tp.name in served_mid and tp.name in served_end:
+                    deltas[tp.name] = (
+                        served_end[tp.name] - served_mid[tp.name], w)
+            tot = sum(d for d, _ in deltas.values())
+            tot_w = sum(w for _, w in deltas.values())
+            if tot > 0 and tot_w > 0:
+                errs = {n: 100.0 * (d / tot - w / tot_w) / (w / tot_w)
+                        for n, (d, w) in deltas.items()}
+                out["per_pool_served"] = {n: d for n, (d, _) in
+                                          deltas.items()}
+                out["weighted_share_err_pct"] = {n: round(e, 1)
+                                                 for n, e in errs.items()}
+                out["weighted_share_err_max_pct"] = round(
+                    max(abs(e) for e in errs.values()), 1)
+        return out
+    finally:
+        ctx.fini(timeout=60)
+
+
+_CHAIN_SRC = (
+    "%global NT\n%global DEPTH\n"
+    "INIT(z)\n  z = 0 .. 0\n"
+    "  CTL S -> (DEPTH >= 1) ? S T(1 .. NT, 1)\nBODY\n  pass\nEND\n\n"
+    "T(i, l)\n  i = 1 .. NT\n  l = 1 .. DEPTH\n"
+    "  CTL S <- (l == 1) ? S INIT(0) : S T(i, l-1)\n"
+    "        -> (l < DEPTH) ? S T(i, l+1)\nBODY\n  pass\nEND\n")
+
+
+def ptexec_multipool_smoke() -> dict:
+    """Three concurrent PTG lane graphs on two workers: the ptexec half
+    of the engagement gate. Asserts by COUNTERS that (a) concurrent
+    pools bind to the plane and are all served, (b) the steal machinery
+    moved work between workers' hot queues, and (c) a LONE pool does NOT
+    bind — the structural form of the single-pool overhead contract (the
+    one-pool fast path is the private ready vector, so the 10M/s chain
+    walk cannot regress by construction)."""
+    from parsec_tpu import Context
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    prog = compile_ptg(_CHAIN_SRC, "serve_chain")
+    ctx = Context(nb_cores=2)
+    plane = ctx.sched_plane
+    out = {"plane": plane is not None}
+    if plane is None:
+        ctx.fini()
+        return out
+    before = plane.stats()
+    tps = [prog.instantiate(ctx, globals={"NT": 256, "DEPTH": 8},
+                            collections={}, name=f"mp-{i}")
+           for i in range(3)]
+    for tp in tps:
+        ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    mid = plane.stats()
+    out["multi_registered"] = mid["pools_registered"] - \
+        before["pools_registered"]
+    out["multi_served"] = mid["served"] - before["served"]
+    out["steals"] = mid["steals"] - before["steals"]
+    # lone pool: must NOT bind (lazy arming = one-pool fast path)
+    tp1 = prog.instantiate(ctx, globals={"NT": 256, "DEPTH": 8},
+                           collections={}, name="solo")
+    ctx.add_taskpool(tp1)
+    ctx.wait(timeout=120)
+    after = plane.stats()
+    out["solo_registered"] = after["pools_registered"] - \
+        mid["pools_registered"]
+    ctx.fini()
+    return out
+
+
+def ci_gate() -> int:
+    """ci.sh ptsched engagement gate: ENGAGEMENT counters, not
+    throughput — a noisy host cannot flake it, a silent fallback fails
+    it deterministically. Three legs: (1) multi-pool DTD serving run
+    (every eligible pool registers, per-pool served nonzero, admission
+    window engages, zero fallbacks), (2) weighted drain-limited run
+    (served shares track 2:1 weights within a generous tolerance),
+    (3) multi-pool ptexec run (steals nonzero across workers; a LONE
+    pool stays on its private ready structure — the single-pool
+    overhead contract in structural form)."""
+    from parsec_tpu.core.sched_plane import SCHED_STATS
+    before = SCHED_STATS.snapshot()
+    r = run_serving(npools=3, nthreads=3, seconds=1.5,
+                    weights=[2, 1, 1], window=512, nb_cores=2)
+    delta = SCHED_STATS.delta(before)
+    print("serving ci-gate:", {k: r.get(k) for k in
+                               ("sustained_inserts_per_sec", "task_p99_us",
+                                "weighted_share_err_max_pct")})
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        print(("PASS " if cond else "FAIL ") + msg)
+        ok = ok and cond
+
+    check(delta.get("pools_engaged", 0) >= 3,
+          f"every pool engaged the plane ({delta.get('pools_engaged')})")
+    check(delta.get("plane_unavailable", 0) == 0 and
+          delta.get("policy_fallback", 0) == 0,
+          "zero plane fallbacks for eligible pools")
+    served = r.get("per_pool_served", {})
+    check(len(served) == 3 and all(v > 0 for v in served.values()),
+          f"per-pool served counters nonzero ({served})")
+    check(r.get("plane", {}).get("served", 0) > 0,
+          "plane served counter nonzero")
+    check(r.get("sustained_inserts_per_sec", 0) > 0, "sustained ingest > 0")
+    check(delta.get("admission_stalls", 0) > 0,
+          f"admission window engaged "
+          f"({delta.get('admission_stalls')} stalls at window 512)")
+    p99 = r.get("task_p99_us")
+    check(p99 is not None and p99 > 0, f"task p99 measured ({p99} us)")
+    # weighted leg: drain-limited (expensive bodies), single feeder so
+    # every pool stays backlogged; 2:1 with a generous 60% tolerance —
+    # the bench reports the tight number, the gate only proves the
+    # arbiter is weighted at all
+    w = run_weighted(npools=2, weights=[2, 1], seconds=2.0,
+                     work=20000, window=1024, nb_cores=2)
+    err = w.get("weighted_share_err_max_pct")
+    print("weighted leg:", {"per_pool_served": w.get("per_pool_served"),
+                            "err_max_pct": err})
+    check(err is not None and err < 60.0,
+          f"weighted shares track 2:1 (max err {err}%)")
+    # ptexec leg: concurrent lane graphs steal across workers; a lone
+    # pool stays unbound (the one-pool fast path)
+    px = ptexec_multipool_smoke()
+    print("ptexec leg:", px)
+    check(px.get("multi_registered", 0) >= 3,
+          "concurrent ptexec pools bound to the plane")
+    check(px.get("multi_served", 0) >= 3 * (256 * 8 + 1),
+          "every ptexec pool's tasks served through the plane")
+    check(px.get("steals", 0) > 0,
+          f"steal machinery alive ({px.get('steals')} steals)")
+    check(px.get("solo_registered", 1) == 0,
+          "lone pool stays on the private ready structure "
+          "(single-pool fast path)")
+    return 0 if ok else 1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ci-gate", action="store_true",
+                    help="multi-pool plane engagement smoke (ci.sh)")
+    ap.add_argument("--pools", type=int, default=8)
+    ap.add_argument("--threads", type=int, default=None)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--window", type=int, default=4096)
+    ap.add_argument("--cores", type=int, default=2)
+    ap.add_argument("--weights", type=str, default=None,
+                    help="comma-separated per-pool QoS weights")
+    args = ap.parse_args()
+    if args.ci_gate:
+        sys.exit(ci_gate())
+    weights = [int(w) for w in args.weights.split(",")] \
+        if args.weights else None
+    r = run_serving(npools=args.pools, nthreads=args.threads,
+                    seconds=args.seconds, weights=weights,
+                    window=args.window, nb_cores=args.cores)
+    import json
+    print(json.dumps(r, indent=2, default=str))
+
+
+if __name__ == "__main__":
+    main()

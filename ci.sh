@@ -18,7 +18,8 @@ assert native.available(), "libptcore.so built but failed to load"
 assert native.load_ptdtd() is not None, "_ptdtd built but failed to load"
 assert native.load_ptexec() is not None, "_ptexec built but failed to load"
 assert native.load_ptcomm() is not None, "_ptcomm built but failed to load"
-print("native artifacts OK (ptcore, ptdtd, ptexec, ptcomm)")
+assert native.load_ptsched() is not None, "_ptsched built but failed to load"
+print("native artifacts OK (ptcore, ptdtd, ptexec, ptcomm, ptsched)")
 EOF
 
 echo "== no compiled artifacts tracked/staged =="
@@ -104,6 +105,15 @@ for t in tiles:
 ctx.fini()
 print(f"DTD batched lane engagement OK: {delta}")
 EOF
+
+echo "== scheduler plane engagement smoke (multi-pool ptsched) =="
+# ISSUE 9: N concurrent taskpools must share the lanes through the native
+# scheduler plane — pools registered (zero fallbacks), per-pool served
+# counters nonzero, steal machinery moving work between workers, the
+# admission window stalling a runaway inserter, 2:1 weights visibly
+# weighting the drain, and a LONE pool staying on its private ready
+# structure (the structural form of the single-pool overhead contract)
+JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/serving.py --ci-gate
 
 echo "== native comm lane engagement smoke (2 ranks) =="
 # same contract as the execution-lane gates: assert ENGAGEMENT, not

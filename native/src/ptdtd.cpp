@@ -64,6 +64,7 @@
 #include "ptcomm_iface.h"
 #include "pthist.h"
 #include "ptrace_ring.h"
+#include "ptsched.h"
 
 namespace {
 
@@ -111,6 +112,9 @@ struct ClassRec {
     std::vector<int32_t> accs;         // per-flow access bits
     int32_t nvals = 0;                 // count of -1 entries in argmap
     int32_t nwrites = 0;               // count of WRITE flows
+    int32_t pool = -1;                 // scheduler-plane pool handle (the
+                                       // QoS identity of the owning
+                                       // taskpool; -1 = private ready)
 };
 
 struct Engine {
@@ -136,6 +140,12 @@ struct Engine {
     std::atomic<ptrace_ring::State *> trace;
     // latency histograms (null until hist_enable)
     std::atomic<pthist::State<N_HISTS> *> hist;
+    // scheduler plane (sched_bind, ISSUE 9): ready batch-lane tasks of
+    // pool-bound classes enter the shared plane instead of `ready`, so N
+    // concurrent DTD taskpools drain by DRR weight; classes without a
+    // pool (plane off, pre-plane pools) keep the private vector
+    ptsched::Plane *splane;
+    PyObject *sched_cap;
 };
 
 PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
@@ -157,6 +167,8 @@ PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
     new (&self->ingest_bad) std::atomic<int64_t>(0);
     new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
     new (&self->hist) std::atomic<pthist::State<N_HISTS> *>(nullptr);
+    self->splane = nullptr;
+    self->sched_cap = nullptr;
     if (!self->mu || !self->tasks || !self->tiles || !self->classes ||
         !self->flow_tile || !self->flow_acc || !self->ready ||
         !self->rsurf) {
@@ -188,7 +200,8 @@ void engine_dealloc(PyObject *obj) {
     delete self->rsurf;
     delete self->trace.load(std::memory_order_acquire);
     delete self->hist.load(std::memory_order_acquire);
-    Py_TYPE(obj)->tp_free(obj);
+    Py_CLEAR(self->sched_cap);   // pool handles are owned by the Python
+    Py_TYPE(obj)->tp_free(obj);  // side (core/sched_plane.py unregisters)
 }
 
 // tile() -> int : register a new tile chain (payload slot starts empty)
@@ -283,18 +296,57 @@ int64_t link_locked(Engine *self, const int64_t *tixs, const int64_t *laccs,
     return tid;
 }
 
+// Push collected (pool, tid) ready pairs into the scheduler plane,
+// contiguous same-pool runs in one plane call each — shared by the
+// insert_many link batch and the drain_ready release walk. Call with
+// NO engine mutex held (the plane has its own locks). ``scratch`` is a
+// caller-owned reusable buffer: this runs on the GIL-dropped hot paths,
+// which must not pay a malloc per pool run.
+void flush_planeq(ptsched::Plane *spl,
+                  std::vector<std::pair<int32_t, int32_t>> &planeq,
+                  int wid, std::vector<int32_t> &scratch) {
+    for (size_t i = 0; i < planeq.size();) {
+        size_t j = i;
+        int32_t ph = planeq[i].first;
+        scratch.clear();
+        while (j < planeq.size() && planeq[j].first == ph)
+            scratch.push_back(planeq[j++].second);
+        spl->push(ph, wid, scratch.data(), nullptr, (int)scratch.size());
+        i = j;
+    }
+    planeq.clear();
+}
+
+// mu held (or GIL for readers: every classes mutator runs under mu AND
+// the GIL). The scheduler-plane pool a batch class drains through, or -1.
+// Plane ids are int32 — an id past 2^31 (weeks of sustained serving on
+// one engine) falls back to the private ready vector rather than wrap.
+inline int32_t plane_pool_of(Engine *self, int32_t cls, int64_t tid) {
+    if (!self->splane || cls < 0 || tid > INT32_MAX) return -1;
+    return (*self->classes)[(size_t)cls].pool;
+}
+
 // The release walk shared by both lanes. MUST be called with mu held.
 // Marks `tid` completed and decrements its successors; newly-ready
-// batch-lane successors go straight onto the internal ready structure,
-// newly-ready per-task-lane successors are appended to `surfaced` for
-// Python to schedule. ``now`` (0 = histograms off) stamps ready pushes
-// for the ready-wait histogram — captured once per caller batch.
+// batch-lane successors go straight onto the internal ready structure —
+// or, for plane-bound classes, into `planeq` (pool, tid32) pairs the
+// caller pushes into the scheduler plane AFTER mu drops (null: pushed
+// inline, the comm-ingest path) — and newly-ready per-task-lane
+// successors are appended to `surfaced` for Python to schedule. ``now``
+// (0 = histograms off) stamps ready pushes for the ready-wait histogram
+// — captured once per caller batch.
 void complete_locked(Engine *self, int64_t tid,
-                     std::vector<int64_t> &surfaced, int64_t now = 0) {
+                     std::vector<int64_t> &surfaced, int64_t now = 0,
+                     std::vector<std::pair<int32_t, int32_t>> *planeq =
+                         nullptr) {
     std::vector<TaskRec> &tasks = *self->tasks;
     TaskRec &rec = tasks[(size_t)tid];
     rec.completed = true;
     self->live--;
+    // admission accounting: the completing task leaves its pool's
+    // in-flight window (one relaxed atomic; safe under mu)
+    int32_t myp = plane_pool_of(self, rec.cls, tid);
+    if (myp >= 0) self->splane->retired(myp, 1);
     // move out the successor list so the record sheds its heap storage
     std::vector<int64_t> succs;
     succs.swap(rec.succs);
@@ -303,7 +355,17 @@ void complete_locked(Engine *self, int64_t tid,
         if (--sr.deps_remaining == 0) {
             if (sr.cls >= 0) {
                 sr.ready_ns = now;
-                self->ready->push_back(s);
+                int32_t ph = plane_pool_of(self, sr.cls, s);
+                if (ph >= 0) {
+                    if (planeq) {
+                        planeq->emplace_back(ph, (int32_t)s);
+                    } else {
+                        int32_t t32 = (int32_t)s;
+                        self->splane->push(ph, -1, &t32, nullptr, 1);
+                    }
+                } else {
+                    self->ready->push_back(s);
+                }
             } else {
                 surfaced.push_back(s);
             }
@@ -466,7 +528,10 @@ PyObject *engine_complete(PyObject *obj, PyObject *arg) {
 PyObject *engine_register_class(PyObject *obj, PyObject *args) {
     Engine *self = reinterpret_cast<Engine *>(obj);
     PyObject *cb, *argmap_o, *accs_o, *retire = Py_None;
-    if (!PyArg_ParseTuple(args, "OOO|O", &cb, &argmap_o, &accs_o, &retire))
+    int pool = -1;     // scheduler-plane pool handle of the owning
+                       // taskpool (QoS routing; -1 = private ready)
+    if (!PyArg_ParseTuple(args, "OOO|Oi", &cb, &argmap_o, &accs_o, &retire,
+                          &pool))
         return nullptr;
     if (!PyCallable_Check(cb)) {
         PyErr_SetString(PyExc_TypeError, "callback must be callable");
@@ -514,6 +579,7 @@ PyObject *engine_register_class(PyObject *obj, PyObject *args) {
         Py_INCREF(retire);
         cr.retire = retire;
     }
+    cr.pool = (pool >= 0 && pool < ptsched::MAX_POOLS) ? pool : -1;
     Py_ssize_t cls;
     {
         std::lock_guard<std::mutex> lk(*self->mu);
@@ -591,6 +657,12 @@ PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
     ptrace_ring::Writer tw;
     tw.open(self->trace.load(std::memory_order_acquire));
     pthist::State<N_HISTS> *hs = hist_of(self);
+    // plane-bound classes: ready pushes and admission bumps collect here
+    // and land AFTER mu drops (the plane has its own locks); admitted
+    // counts group per pool so a batch costs one admit() per pool
+    std::vector<std::pair<int32_t, int32_t>> planeq;
+    std::vector<std::pair<int32_t, int64_t>> admitted;
+    std::vector<int32_t> pscratch;
     PyThreadState *ts = PyEval_SaveThread();
     if (tw.st) tw.rec(EV_LINK, (int64_t)ntask, ptrace_ring::FLAG_START);
     {
@@ -611,14 +683,26 @@ PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
             rec.flow_off = base + sp.foff;
             rec.flow_n = sp.nflows;
             rec.vals = sp.vals;           // ownership moves to the record
+            int32_t ph = plane_pool_of(self, sp.cls, tid);
+            if (ph >= 0) {
+                bool seen = false;
+                for (auto &a : admitted)
+                    if (a.first == ph) { a.second++; seen = true; break; }
+                if (!seen) admitted.emplace_back(ph, 1);
+            }
             // count-then-activate: the record is fully stored; drop the
             // guard. 0 deps -> straight onto the internal ready structure
             if (--rec.deps_remaining == 0) {
                 rec.ready_ns = h_now;
-                self->ready->push_back(tid);
+                if (ph >= 0)
+                    planeq.emplace_back(ph, (int32_t)tid);
+                else
+                    self->ready->push_back(tid);
             }
         }
     }
+    for (auto &a : admitted) self->splane->admit(a.first, a.second);
+    if (!planeq.empty()) flush_planeq(self->splane, planeq, -1, pscratch);
     if (tw.st) tw.rec(EV_LINK, (int64_t)ntask, ptrace_ring::FLAG_END);
     PyEval_RestoreThread(ts);
     return PyLong_FromSsize_t(ntask);
@@ -639,7 +723,8 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
     Engine *self = reinterpret_cast<Engine *>(obj);
     int max_batch = 256;
     long long budget = 4096;
-    if (!PyArg_ParseTuple(args, "|iL", &max_batch, &budget))
+    int wid = 0;    // worker id — scheduler-plane hot-queue affinity
+    if (!PyArg_ParseTuple(args, "|iLi", &max_batch, &budget, &wid))
         return nullptr;
     if (max_batch <= 0) max_batch = 256;
     long long total = 0;
@@ -654,22 +739,47 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
     std::vector<std::pair<int32_t, int64_t>> local;
     std::vector<PyObject *> argrefs, defer_decref;
     std::vector<int32_t> accs_snap, argmap_snap;
+    // scheduler plane: mixed-pool pops (hot queue -> weighted-DRR refill
+    // -> steal), arbitrating across every registered DTD taskpool; the
+    // per-class grouping below then batches them regardless of pool.
+    // Releases push back with this worker's identity after mu drops.
+    ptsched::Plane *const spl = self->splane;
+    std::vector<ptsched::Item> pitems;
+    std::vector<std::pair<int32_t, int32_t>> planeq;
+    std::vector<int32_t> pscratch;
+    if (spl) pitems.resize((size_t)max_batch);
     for (;;) {
         local.clear();
+        int pgot = 0;
+        if (spl)
+            pgot = spl->pop(wid, ptsched::KIND_PTDTD, -1, pitems.data(),
+                            max_batch);
         {
             std::lock_guard<std::mutex> lk(*self->mu);
-            if (self->poisoned || self->ready->empty()) break;
-            size_t take = std::min((size_t)max_batch, self->ready->size());
+            if (self->poisoned) break;   // popped ids die with the engine
             const int64_t h_now = hs ? ptrace_ring::now_ns() : 0;
-            for (size_t k = self->ready->size() - take;
-                 k < self->ready->size(); k++) {
-                int64_t tid = (*self->ready)[k];
-                TaskRec &rec = (*self->tasks)[(size_t)tid];
-                if (h_now && rec.ready_ns > 0)
-                    hs->h[H_READY].add(h_now - rec.ready_ns);
-                local.emplace_back(rec.cls, tid);
+            if (pgot) {
+                for (int k = 0; k < pgot; k++) {
+                    int64_t tid = (int64_t)pitems[(size_t)k].tid;
+                    TaskRec &rec = (*self->tasks)[(size_t)tid];
+                    if (h_now && rec.ready_ns > 0)
+                        hs->h[H_READY].add(h_now - rec.ready_ns);
+                    local.emplace_back(rec.cls, tid);
+                }
+            } else {
+                if (self->ready->empty()) break;
+                size_t take =
+                    std::min((size_t)max_batch, self->ready->size());
+                for (size_t k = self->ready->size() - take;
+                     k < self->ready->size(); k++) {
+                    int64_t tid = (*self->ready)[k];
+                    TaskRec &rec = (*self->tasks)[(size_t)tid];
+                    if (h_now && rec.ready_ns > 0)
+                        hs->h[H_READY].add(h_now - rec.ready_ns);
+                    local.emplace_back(rec.cls, tid);
+                }
+                self->ready->resize(self->ready->size() - take);
             }
-            self->ready->resize(self->ready->size() - take);
         }
         // group by class so each callback sees one homogeneous batch; the
         // snapshot pairs keep the comparator off the live tasks vector
@@ -828,10 +938,15 @@ PyObject *engine_drain_ready(PyObject *obj, PyObject *args) {
                     if (tw.st)
                         tw.rec(EV_TASK, local[t].second,
                                ptrace_ring::FLAG_POINT);
-                    complete_locked(self, local[t].second, surfaced, h_now);
+                    complete_locked(self, local[t].second, surfaced, h_now,
+                                    spl ? &planeq : nullptr);
                 }
                 self->batch_done += (int64_t)gn;
             }
+            if (!planeq.empty())
+                // newly-ready plane tasks from this batch's release walk
+                // enter with this worker's hot-queue affinity
+                flush_planeq(spl, planeq, wid, pscratch);
             if (hs) {
                 // per-task (class, batch) latency: gather + dispatch +
                 // landing + release amortized over the batch
@@ -1018,6 +1133,9 @@ PyObject *engine_release_pool(PyObject *obj, PyObject *args) {
                 defer_decref.push_back(cr.retire);
                 cr.retire = nullptr;
             }
+            // the plane pool slot may be reused after the Python side
+            // unregisters it — a dead class must never route there
+            cr.pool = -1;
         }
     }
     for (PyObject *p : defer_decref) Py_DECREF(p);
@@ -1136,8 +1254,10 @@ PyObject *engine_pending(PyObject *obj, PyObject *) {
 
 PyObject *engine_ready_count(PyObject *obj, PyObject *) {
     Engine *self = reinterpret_cast<Engine *>(obj);
+    int64_t plane_q = self->splane
+        ? self->splane->queued_kind(ptsched::KIND_PTDTD) : 0;
     std::lock_guard<std::mutex> lk(*self->mu);
-    return PyLong_FromSsize_t((Py_ssize_t)self->ready->size());
+    return PyLong_FromLongLong((long long)self->ready->size() + plane_q);
 }
 
 PyObject *engine_batch_executed(PyObject *obj, PyObject *) {
@@ -1176,7 +1296,13 @@ void dtd_ingest_act_c(void *obj, int32_t tid) {
     if (--rec.deps_remaining == 0) {
         if (rec.cls >= 0) {
             rec.ready_ns = hist_of(self) ? ptrace_ring::now_ns() : 0;
-            self->ready->push_back(tid);
+            int32_t ph = plane_pool_of(self, rec.cls, tid);
+            if (ph >= 0) {
+                int32_t t32 = (int32_t)tid;
+                self->splane->push(ph, -1, &t32, nullptr, 1);
+            } else {
+                self->ready->push_back(tid);
+            }
         } else {
             self->rsurf->push_back(tid);
         }
@@ -1209,6 +1335,35 @@ PyObject *engine_ingest(PyObject *obj, PyObject *arg) {
     Py_RETURN_NONE;
 }
 
+// --------------------------------------------------- scheduler plane bind
+
+// sched_bind(plane_capsule) — attach the shared scheduler plane: classes
+// registered with a pool handle then route their ready tasks through it
+// (drain_ready pops arbitrate across pools by DRR weight). Idempotent
+// for the same plane; the engine is per-context and the plane per-context
+// too, so a second different plane is a caller bug.
+PyObject *engine_sched_bind(PyObject *obj, PyObject *arg) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    ptsched::Plane *pl = ptsched::plane_from_capsule(arg);
+    if (!pl) return nullptr;
+    if (self->splane && self->splane != pl) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "engine already bound to another scheduler plane");
+        return nullptr;
+    }
+    if (!self->splane) {
+        Py_INCREF(arg);
+        self->sched_cap = arg;
+        self->splane = pl;
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject *engine_sched_bound(PyObject *obj, PyObject *) {
+    return PyBool_FromLong(
+        reinterpret_cast<Engine *>(obj)->splane != nullptr ? 1 : 0);
+}
+
 PyObject *engine_comm_stats(PyObject *obj, PyObject *) {
     Engine *self = reinterpret_cast<Engine *>(obj);
     long long rs;
@@ -1235,14 +1390,21 @@ PyMethodDef engine_methods[] = {
     {"complete", engine_complete, METH_O,
      "complete(task_id) -> tuple of newly-ready per-task-lane ids"},
     {"register_class", engine_register_class, METH_VARARGS,
-     "register_class(callback, argmap, accs[, retire]) -> batch-lane "
-     "class id; retire(n) fires after each batch's outputs land"},
+     "register_class(callback, argmap, accs[, retire[, pool]]) -> "
+     "batch-lane class id; retire(n) fires after each batch's outputs "
+     "land; pool routes ready tasks through the bound scheduler plane"},
     {"insert_many", engine_insert_many, METH_O,
      "insert_many(specs) -> count; links the whole batch under one GIL "
      "drop (count-then-activate per task)"},
     {"drain_ready", engine_drain_ready, METH_VARARGS,
-     "drain_ready(max_batch=256, budget=4096) -> (n_executed, surfaced); "
-     "runs ready batch-lane tasks via per-class batched callbacks"},
+     "drain_ready(max_batch=256, budget=4096, wid=0) -> (n_executed, "
+     "surfaced); runs ready batch-lane tasks via per-class batched "
+     "callbacks (wid = scheduler-plane hot-queue affinity)"},
+    {"sched_bind", engine_sched_bind, METH_O,
+     "sched_bind(plane_capsule): attach the shared scheduler plane "
+     "(see native/src/ptsched.h); idempotent for the same plane"},
+    {"sched_bound", engine_sched_bound, METH_NOARGS,
+     "True when a scheduler plane is attached"},
     {"slot_set", engine_slot_set, METH_VARARGS,
      "slot_set(tile_id, payload): seed/refresh a tile's payload slot"},
     {"slot_get", engine_slot_get, METH_O,

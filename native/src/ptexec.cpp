@@ -56,6 +56,7 @@
 #include "ptcomm_iface.h"
 #include "pthist.h"
 #include "ptrace_ring.h"
+#include "ptsched.h"
 
 namespace {
 
@@ -124,6 +125,14 @@ struct Graph {
     std::atomic<int64_t> acts_tx;       // remote releases surfaced
     std::atomic<int64_t> acts_rx;       // remote decrements ingested
     std::atomic<int64_t> ingest_bad;    // out-of-range ids from the wire
+    // scheduler plane binding (sched_bind, ISSUE 9): when set, the ready
+    // structure lives in the shared multi-pool plane (pool `spool`) — N
+    // concurrent lane graphs then share the workers by DRR weight instead
+    // of whoever sits at the front of the context's lane queue. The
+    // capsule ref keeps the plane alive for the binding window.
+    ptsched::Plane *splane;
+    int32_t spool;
+    PyObject *sched_cap;
 };
 
 bool parse_i32_list(PyObject *obj, std::vector<int32_t> &out,
@@ -164,16 +173,37 @@ bool slots_pending_locked(Graph *g, int32_t t) {
 }
 
 // mu held. Enter the ready structure (heap-aware) unless an input slot's
-// rendezvous is still in flight — then park until rdv_land().
+// rendezvous is still in flight — then park until rdv_land(). With a
+// scheduler plane bound the item enters the plane instead (anonymous
+// producer: the callers here — ingest, rdv_land, seeding — have no worker
+// identity; the run() release sweep pushes batched with its worker id).
 void push_ready_locked(Graph *g, int32_t s) {
     if (g->comm_bound && slots_pending_locked(g, s)) {
         g->parked->push_back(s);
+        return;
+    }
+    if (g->splane) {
+        int32_t prio = g->use_heap ? (*g->prio)[(size_t)s] : 0;
+        g->splane->push(g->spool, -1, &s, g->use_heap ? &prio : nullptr, 1);
         return;
     }
     g->ready->push_back(s);
     if (g->use_heap)
         std::push_heap(g->ready->begin(), g->ready->end(),
                        PrioLess{g->prio->data()});
+}
+
+// fill `prios` with the per-task priorities of `ids` for a plane push
+// (heap pools only); returns the array to pass, or null for non-heap.
+// Shared by seeding (reset), the bind-time migration, and the release
+// sweep so the priority-stamping rule lives in one place.
+const int32_t *gather_prios(Graph *g, const std::vector<int32_t> &ids,
+                            std::vector<int32_t> &prios) {
+    if (!g->use_heap) return nullptr;
+    prios.clear();
+    prios.reserve(ids.size());
+    for (int32_t s : ids) prios.push_back((*g->prio)[(size_t)s]);
+    return prios.data();
 }
 
 // recompute the seed list: with owners bound, only LOCAL zero-goal tasks
@@ -194,10 +224,23 @@ void graph_reset_state(Graph *self) {
     for (int64_t i = 0; i < self->n; i++)
         self->counts[i].store((*self->goals)[(size_t)i],
                               std::memory_order_relaxed);
-    *self->ready = *self->seeds;
-    if (self->use_heap)
-        std::make_heap(self->ready->begin(), self->ready->end(),
-                       PrioLess{self->prio->data()});
+    if (self->splane) {
+        // plane-resident ready structure: flush stale items of an
+        // abandoned run, then seed the pool afresh
+        self->splane->pool_clear(self->spool);
+        self->ready->clear();
+        if (!self->seeds->empty()) {
+            std::vector<int32_t> prios;
+            self->splane->push(self->spool, -1, self->seeds->data(),
+                               gather_prios(self, *self->seeds, prios),
+                               (int)self->seeds->size());
+        }
+    } else {
+        *self->ready = *self->seeds;
+        if (self->use_heap)
+            std::make_heap(self->ready->begin(), self->ready->end(),
+                           PrioLess{self->prio->data()});
+    }
     std::fill(self->rdv_pending->begin(), self->rdv_pending->end(),
               (uint8_t)0);
     self->parked->clear();
@@ -252,6 +295,9 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     new (&self->acts_tx) std::atomic<int64_t>(0);
     new (&self->acts_rx) std::atomic<int64_t>(0);
     new (&self->ingest_bad) std::atomic<int64_t>(0);
+    self->splane = nullptr;
+    self->spool = -1;
+    self->sched_cap = nullptr;
     if (!self->goals || !self->succ_off || !self->succs || !self->seeds ||
         !self->ready || !self->mu || !self->prio || !self->in_off ||
         !self->in_slots || !self->slot_uses || !self->retired ||
@@ -404,6 +450,13 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
 
 void graph_dealloc(PyObject *obj) {
     Graph *self = reinterpret_cast<Graph *>(obj);
+    if (self->splane) {
+        // a graph dying while bound owns its pool slot: free it so the
+        // plane never serves stale ids from a dead graph
+        self->splane->pool_unregister(self->spool);
+        self->splane = nullptr;
+    }
+    Py_CLEAR(self->sched_cap);
     delete self->goals;
     delete self->succ_off;
     delete self->succs;
@@ -463,7 +516,8 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
     PyObject *callback = Py_None;
     int batch = 256;
     long long budget = 0;
-    if (!PyArg_ParseTuple(args, "|OiL", &callback, &batch, &budget))
+    int wid = 0;    // worker id — the scheduler plane's hot-queue affinity
+    if (!PyArg_ParseTuple(args, "|OiLi", &callback, &batch, &budget, &wid))
         return nullptr;
     if (batch <= 0) batch = 256;
     if (callback != Py_None && !PyCallable_Check(callback)) {
@@ -483,8 +537,12 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
     const int32_t *ioff = data_mode ? self->in_off->data() : nullptr;
     const int32_t *islot = data_mode ? self->in_slots->data() : nullptr;
     const PrioLess cmp{self->use_heap ? self->prio->data() : nullptr};
-    std::vector<int32_t> local, fresh, freed;
+    std::vector<int32_t> local, fresh, freed, fprio;
     local.reserve((size_t)batch);
+    // plane-resident ready structure: pops come out of the shared
+    // scheduler plane (hot queue -> pool overflow -> steal) instead of
+    // the private vector; pushes go back with this worker's identity
+    ptsched::Plane *const spl = self->splane;
     int64_t mine = 0;
     // in-lane tracing: claim a per-worker ring for this call's duration
     // (tw.st stays null when tracing is off — one predictable branch per
@@ -503,7 +561,49 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
     PyThreadState *ts = PyEval_SaveThread();   // GIL dropped for the walk
     for (;;) {
         bool stop = false;
-        {
+        if (spl) {
+            local.resize((size_t)batch);
+            int got = spl->pop_pool(self->spool, wid, local.data(), batch);
+            local.resize((size_t)got);
+            if (got == 0) {
+                // drain private-vector leftovers: a graph bound to the
+                // plane MID-RUN (lazy arming on the second concurrent
+                // pool) may have peers with a pre-bind snapshot still
+                // pushing releases into the old structure
+                std::lock_guard<std::mutex> lk(*self->mu);
+                if (!self->error && !self->ready->empty()) {
+                    size_t take =
+                        std::min((size_t)batch, self->ready->size());
+                    if (self->use_heap) {
+                        local.clear();
+                        for (size_t i = 0; i < take; i++) {
+                            std::pop_heap(self->ready->begin(),
+                                          self->ready->end(), cmp);
+                            local.push_back(self->ready->back());
+                            self->ready->pop_back();
+                        }
+                    } else {
+                        local.assign(self->ready->end() - (ptrdiff_t)take,
+                                     self->ready->end());
+                        self->ready->resize(self->ready->size() - take);
+                    }
+                    self->running++;
+                } else {
+                    local.clear();
+                    stop = true;   // starved (or done) — caller decides
+                }
+            } else {
+                std::lock_guard<std::mutex> lk(*self->mu);
+                if (self->error) {
+                    // poisoned while we popped: drop the claim (the graph
+                    // never completes once poisoned, ids need no return)
+                    local.clear();
+                    stop = true;
+                } else {
+                    self->running++;
+                }
+            }
+        } else {
             std::lock_guard<std::mutex> lk(*self->mu);
             if (self->error || self->ready->empty()) {
                 stop = true;   // done, starved, or poisoned — caller decides
@@ -635,11 +735,15 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                     self->ready_stamp[s].store(now,
                                                std::memory_order_relaxed);
         }
+        // plane-bound graphs push releases AFTER the bookkeeping lock
+        // drops (the plane has its own locks; rdv-gated distributed data
+        // pools keep the per-item mu-held path, which is plane-aware)
+        const bool plane_batch = spl && !(bound && !self->in_off->empty());
         {
             std::lock_guard<std::mutex> lk(*self->mu);
             self->completed += (int64_t)local.size();
             self->running--;
-            if (!fresh.empty()) {
+            if (!fresh.empty() && !plane_batch) {
                 if (bound && !self->in_off->empty()) {
                     // distributed data pool: gate on in-flight rendezvous
                     for (int32_t s : fresh) push_ready_locked(self, s);
@@ -660,6 +764,10 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                 self->nb_slots_retired += (int64_t)freed.size();
             }
         }
+        if (plane_batch && !fresh.empty())
+            spl->push(self->spool, wid, fresh.data(),
+                      gather_prios(self, fresh, fprio),
+                      (int)fresh.size());
         if (hs && !local.empty()) {
             // per-task execute latency, batch-amortized: the whole
             // dispatch + release sweep cost divided across the batch,
@@ -681,8 +789,11 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
 PyObject *graph_done(PyObject *obj, PyObject *) {
     Graph *self = reinterpret_cast<Graph *>(obj);
     std::lock_guard<std::mutex> lk(*self->mu);
+    bool ready_empty =
+        self->ready->empty() &&
+        (!self->splane || self->splane->queued_of(self->spool) == 0);
     if (!self->error && self->completed == self->n_local &&
-        self->ready->empty() && self->running == 0)
+        ready_empty && self->running == 0)
         Py_RETURN_TRUE;
     Py_RETURN_FALSE;
 }
@@ -844,6 +955,107 @@ PyObject *graph_comm_bind(PyObject *obj, PyObject *args) {
     return Py_BuildValue("L", (long long)self->n_local);
 }
 
+// --------------------------------------------------- scheduler plane bind
+
+// sched_bind(plane_capsule, pool_handle) — move this graph's ready
+// structure into the shared scheduler plane (ISSUE 9): pushes enter the
+// plane (per-worker hot queues / per-pool heaps), pops come back through
+// run()'s plane path, and the Context arbitrates ACROSS bound graphs by
+// DRR weight. Items already ready (seeds, a reset graph) migrate now.
+// The graph owns the pool slot: sched_unbind()/dealloc frees it.
+PyObject *graph_sched_bind(PyObject *obj, PyObject *args) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    PyObject *cap;
+    int h;
+    if (!PyArg_ParseTuple(args, "Oi", &cap, &h))
+        return nullptr;
+    ptsched::Plane *pl = ptsched::plane_from_capsule(cap);
+    if (!pl) return nullptr;
+    if (h < 0 || h >= ptsched::MAX_POOLS) {
+        PyErr_SetString(PyExc_IndexError, "bad pool handle");
+        return nullptr;
+    }
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (self->splane) {
+        PyErr_SetString(PyExc_RuntimeError, "graph already sched-bound");
+        return nullptr;
+    }
+    // binding MID-RUN is legal (lazy arming on the second concurrent
+    // pool): the ready vector migrates under mu here; a worker holding a
+    // pre-bind snapshot keeps pushing/popping the private vector, whose
+    // leftovers plane-era pops drain under the same mu — nothing is lost
+    // or duplicated, only the heap ordering mixes transiently
+    Py_INCREF(cap);
+    self->sched_cap = cap;
+    self->splane = pl;
+    self->spool = h;
+    if (self->use_heap) {
+        // a priority graph's plane pool must keep heap order from the
+        // first push — per-batch all-zero priorities must not slip into
+        // the FIFO-ish hot queues ahead of heaped higher priorities
+        std::lock_guard<std::mutex> pm(pl->pools[h].mu);
+        pl->pools[h].heap = true;
+    }
+    if (!self->ready->empty()) {
+        std::vector<int32_t> prios;
+        pl->push(h, -1, self->ready->data(),
+                 gather_prios(self, *self->ready, prios),
+                 (int)self->ready->size());
+        self->ready->clear();
+    }
+    Py_RETURN_NONE;
+}
+
+// sched_unbind() — leave the plane: straggler items are swept, the pool
+// slot freed, the capsule ref dropped. Any already-ready items migrate
+// back into the private vector first (an errored/finished graph has
+// none that matter; a live rebind path must not lose work).
+PyObject *graph_sched_unbind(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (!self->splane) Py_RETURN_NONE;
+    if (self->running > 0) {
+        // a mid-batch worker's release sweep would push into a freed
+        // (possibly reused) pool slot; callers unbind at idle points
+        // (finalize, abandon-after-poison)
+        PyErr_SetString(PyExc_RuntimeError,
+                        "sched_unbind() while workers are running");
+        return nullptr;
+    }
+    ptsched::Plane *pl = self->splane;
+    int h = self->spool;
+    // migrate EVERY queued item back into the private structure before
+    // the slot frees (pool_drain_all takes blocking locks — the regular
+    // pop's try_lock steal would skip a contended victim's hot queue and
+    // the unregister sweep would then silently drop its items)
+    std::vector<int32_t> left;
+    pl->pool_drain_all(h, left);
+    for (int32_t t : left) {
+        self->ready->push_back(t);
+        if (self->use_heap)
+            std::push_heap(self->ready->begin(), self->ready->end(),
+                           PrioLess{self->prio->data()});
+    }
+    pl->pool_unregister(h);
+    self->splane = nullptr;
+    self->spool = -1;
+    Py_CLEAR(self->sched_cap);
+    Py_RETURN_NONE;
+}
+
+PyObject *graph_sched_stats(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    if (!self->splane) Py_RETURN_NONE;
+    ptsched::Pool &p = self->splane->pools[self->spool];
+    return Py_BuildValue(
+        "{s:i,s:L,s:L,s:L,s:L}",
+        "pool", (int)self->spool,
+        "queued", (long long)p.queued.load(std::memory_order_relaxed),
+        "served", (long long)p.served.load(std::memory_order_relaxed),
+        "spills", (long long)p.spills.load(std::memory_order_relaxed),
+        "inflight", (long long)p.inflight.load(std::memory_order_relaxed));
+}
+
 // Python-side mirrors of the C ingest entries (tests + non-native drivers)
 PyObject *graph_ingest(PyObject *obj, PyObject *arg) {
     long tid = PyLong_AsLong(arg);
@@ -959,7 +1171,17 @@ PyObject *graph_hist_snapshot(PyObject *obj, PyObject *) {
 
 PyMethodDef graph_methods[] = {
     {"run", graph_run, METH_VARARGS,
-     "run(callback=None, batch=256, budget=0) -> tasks executed by this call"},
+     "run(callback=None, batch=256, budget=0, wid=0) -> tasks executed by "
+     "this call (wid = scheduler-plane hot-queue affinity when bound)"},
+    {"sched_bind", graph_sched_bind, METH_VARARGS,
+     "sched_bind(plane_capsule, pool_handle): move the ready structure "
+     "into the shared scheduler plane (see native/src/ptsched.h)"},
+    {"sched_unbind", graph_sched_unbind, METH_NOARGS,
+     "leave the scheduler plane (frees the pool slot; queued items "
+     "migrate back to the private ready structure)"},
+    {"sched_stats", graph_sched_stats, METH_NOARGS,
+     "{pool, queued, served, spills, inflight} of the bound plane pool, "
+     "or None when unbound"},
     {"reset", graph_reset, METH_NOARGS,
      "rewind dependency counters, slots, and the ready structure for replay"},
     {"done", graph_done, METH_NOARGS,

@@ -151,6 +151,14 @@ class Context:
         self.sched.install(self)
         for s in self.streams:
             self.sched.flow_init(s)
+        #: native multi-pool scheduler plane (core/sched_plane.py, ISSUE
+        #: 9): the shared ready plane the ptexec/ptdtd lanes drain
+        #: through — per-worker hot queues, work stealing, weighted DRR
+        #: across taskpools, admission windows. None when --mca
+        #: sched_native 0, the native module is missing, or the selected
+        #: scheduler policy has no native flavor (counted fallback)
+        from .sched_plane import SchedPlane
+        self.sched_plane = SchedPlane.maybe_create(self)
         # device registry (lazy import to avoid cycles)
         from ..device.device import DeviceRegistry
         self.devices = DeviceRegistry(self)
@@ -235,6 +243,9 @@ class Context:
         #: percentiles); off = one null branch per lane event site
         self._hist_on = bool(mca.get("hist_enabled", False)) or \
             self.metrics is not None
+        if self.sched_plane is not None:
+            # sched.queue_ns (push->pop wait) joins the lane histograms
+            self._hist_attach("sched", self.sched_plane.plane)
         output.debug_verbose(2, "runtime",
                              f"context up: {self.nb_cores} streams, sched={self.sched.name}")
 
@@ -461,6 +472,9 @@ class Context:
             # point: fold its buckets here so the process-wide registry
             # does not pin one engine per finished context forever
             self._hist_detach(self._dtd_neng)
+        if self.sched_plane is not None:
+            # same lifecycle for the plane's queue-wait histogram
+            self._hist_detach(self.sched_plane.plane)
         if self.metrics is not None:
             # endpoint down LAST: ops dashboards may scrape through the
             # drain, and the fini counter aggregation itself is scrapeable
@@ -528,7 +542,43 @@ class Context:
             self._ptexec_q.append((tp, lane))
             if lane.get("pool_id") is not None:
                 self._ptexec_comm_live += 1
+            # scheduler plane, LAZY arming (the one-pool fast path): a
+            # lone lane graph keeps its private allocation-free ready
+            # vector — zero plane crossings on the 10M/s chain walk. The
+            # moment a SECOND pool runs concurrently (or a pool carries
+            # explicit QoS config), every queued lane binds: ready
+            # structures migrate into the plane mid-run (safe hand-off,
+            # see ptexec.cpp sched_bind) and the drain arbitrates by DRR
+            if self.sched_plane is not None and (
+                    len(self._ptexec_q) > 1
+                    or getattr(tp, "qos_weight", None)
+                    or getattr(tp, "admission_window", None)
+                    or mca.get("sched_admission_window", 0)):
+                for tp_i, lane_i in self._ptexec_q:
+                    self._sched_pool_bind(tp_i, lane_i)
         self._work_event.set()
+
+    def _sched_pool_bind(self, tp: Taskpool, lane: Dict[str, Any]) -> None:
+        """Register ``tp`` on the scheduler plane and move its lane
+        graph's ready structure there (idempotent; declines — full pool
+        table, bind refusal — keep the private vector: engagement is
+        unchanged, only cross-pool arbitration is lost)."""
+        plane = self.sched_plane
+        if plane is None or lane.get("sched_pool") is not None \
+                or lane.get("finalized"):
+            return
+        h = plane.register_pool(tp.name, plane.KIND_PTEXEC,
+                                weight=getattr(tp, "qos_weight", None),
+                                window=getattr(tp, "admission_window",
+                                               None))
+        if h < 0:
+            return
+        try:
+            lane["graph"].sched_bind(plane.capsule, h)
+        except Exception:  # noqa: BLE001 — keep the private structure
+            plane.unregister_pool(h)
+            return
+        lane["sched_pool"] = h
 
     def _ptexec_drain(self, stream: ExecutionStream) -> bool:
         """One burst through the front lane graph. The burst budget shrinks
@@ -542,11 +592,33 @@ class Context:
         engine retired (the datarepo usagelmt/usagecnt protocol, kept in C)
         — generic_prepare_input / generic_release_deps never run for lane
         tasks. One callback per ~256 ready tasks amortizes the
-        lane-crossing cost the per-task FSM used to pay on every task."""
+        lane-crossing cost the per-task FSM used to pay on every task.
+
+        With the scheduler plane armed and SEVERAL lane graphs queued,
+        the pool to serve is picked by the plane's weighted DRR
+        (next_ptexec) instead of always the FRONT graph — N concurrent
+        taskpools then share the workers by QoS weight with a structural
+        starvation bound, and the burst budget is capped by the pool's
+        DRR quantum so one heavy pool cannot monopolize a worker between
+        arbitration points (charge() spends the credits back)."""
+        plane = self.sched_plane
+        quantum = None
+        pool_h = None
         with self._ptexec_lock:
             if not self._ptexec_q:
                 return False
             tp, lane = self._ptexec_q[0]
+            if plane is not None and len(self._ptexec_q) > 1:
+                pick = plane.next_ptexec()
+                if pick is not None:
+                    h, quantum = pick
+                    for tp_i, lane_i in self._ptexec_q:
+                        if lane_i.get("sched_pool") == h:
+                            tp, lane = tp_i, lane_i
+                            pool_h = h
+                            break
+                    else:
+                        quantum = None   # pool already retired: front graph
         graph = lane["graph"]
         # short bursts whenever (a) ordinary queues hold work, or (b) the
         # lane dispatches Python bodies (eager CTL callbacks or the
@@ -559,8 +631,12 @@ class Context:
             budget = 4096
         else:
             budget = 1 << 22
+        if quantum is not None:
+            # multi-pool arbitration: the burst spends this pool's DRR
+            # credits, then returns to the arbiter for the next pick
+            budget = max(256, min(budget, quantum))
         try:
-            mine = graph.run(lane["callback"], 256, budget)
+            mine = graph.run(lane["callback"], 256, budget, stream.th_id)
             if mine == 0 and lane.get("pool_id") is not None \
                     and not graph.failed() and not graph.done():
                 # comm-bound lane starved mid-graph: the next ready task
@@ -573,15 +649,13 @@ class Context:
                     # yield-spin first (the GIL is free: the comm thread
                     # runs without it), then ease into short naps
                     time.sleep(0 if spin < 200 else 2e-5)
-                    mine = graph.run(lane["callback"], 256, budget)
+                    mine = graph.run(lane["callback"], 256, budget,
+                                     stream.th_id)
                     if mine or graph.failed() or graph.done():
                         break
         except BaseException as e:  # noqa: BLE001 — a body raised
             with self._ptexec_lock:
-                if self._ptexec_q and self._ptexec_q[0][1] is lane:
-                    self._ptexec_q.pop(0)
-                    if lane.get("pool_id") is not None:
-                        self._ptexec_comm_live -= 1
+                self._ptexec_retire_locked(lane)
             self._ptexec_abandon(lane)
             if self._error is None:
                 self._error = e
@@ -590,14 +664,13 @@ class Context:
                 raise           # workers park; the master surfaces the error
             return True
         stream.nb_executed += mine
+        if pool_h is not None and mine:
+            plane.charge(pool_h, mine)
         if graph.failed():
             # poisoned by another stream's body exception: that stream
             # owns the propagation; just retire the queue entry
             with self._ptexec_lock:
-                if self._ptexec_q and self._ptexec_q[0][1] is lane:
-                    self._ptexec_q.pop(0)
-                    if lane.get("pool_id") is not None:
-                        self._ptexec_comm_live -= 1
+                self._ptexec_retire_locked(lane)
             self._ptexec_abandon(lane)
             return True
         if graph.done():
@@ -606,18 +679,43 @@ class Context:
                 if not lane.get("finalized"):
                     lane["finalized"] = True
                     fin = True
-                if self._ptexec_q and self._ptexec_q[0][1] is lane:
-                    self._ptexec_q.pop(0)
-                    if lane.get("pool_id") is not None:
-                        self._ptexec_comm_live -= 1
+                self._ptexec_retire_locked(lane)
             if fin:
                 tp._ptexec_finalize(lane)
                 # ring lifecycle (quiescence): land the finished graph's
                 # events and stop pinning it
                 self._ntrace_detach(lane["graph"])
                 self._hist_detach(lane["graph"])
+                self._sched_pool_retire(lane)
             return True
         return mine > 0
+
+    def _ptexec_retire_locked(self, lane: Dict[str, Any]) -> None:
+        """Drop ``lane`` from the drain queue wherever it sits (the DRR
+        arbiter serves graphs out of front order). _ptexec_lock held."""
+        for i, (_tp, l_) in enumerate(self._ptexec_q):
+            if l_ is lane:
+                self._ptexec_q.pop(i)
+                if lane.get("pool_id") is not None:
+                    self._ptexec_comm_live -= 1
+                return
+
+    def _sched_pool_retire(self, lane: Dict[str, Any]) -> None:
+        """Free a finished/errored lane graph's scheduler-plane pool slot
+        (idempotent: sched_unbind on an unbound graph is a no-op)."""
+        h = lane.get("sched_pool")
+        if h is None or self.sched_plane is None:
+            return
+        try:
+            # the GRAPH owns its slot (sched_unbind frees it natively);
+            # the wrapper only forgets the name mapping — a second free
+            # here could kill an unrelated pool that reused the slot
+            lane["graph"].sched_unbind()
+        except Exception:  # noqa: BLE001 — a peer is still mid-batch
+            return      # (poisoned graph): keep the handle so the next
+                        # stream's abandon retries; dealloc frees anyway
+        lane.pop("sched_pool", None)
+        self.sched_plane.forget_pool(h)
 
     def _dtd_drain(self, stream: ExecutionStream) -> bool:
         """One burst through the DTD engine's batched ready-drain (the
@@ -632,7 +730,7 @@ class Context:
         if eng is None:
             return False
         try:
-            nexec, surfaced = eng.drain_ready(256, 4096)
+            nexec, surfaced = eng.drain_ready(256, 4096, stream.th_id)
         except BaseException as e:  # noqa: BLE001 — a batched body raised
             if self._error is None:
                 self._error = e
@@ -662,6 +760,7 @@ class Context:
         taskpool's remaining lifetime."""
         self._ntrace_detach(lane["graph"])   # final drain of an errored lane
         self._hist_detach(lane["graph"])
+        self._sched_pool_retire(lane)        # free the plane pool slot
         slots = lane.get("slots")
         if not slots:
             return
@@ -859,6 +958,15 @@ class Context:
                 # the comm progress thread, not from this process, and a
                 # ms-scale sleep would dominate every cross-rank hop
                 cap = 2e-5 if self._ptexec_comm_live else backoff_max
+                if cap == backoff_max and self.sched_plane is not None \
+                        and (self._ptexec_q or self._dtd_batch_pools) \
+                        and self.sched_plane.queued_total() > 0:
+                    # "no local work" is NOT global with multiple pools:
+                    # this stream's last pick starved, but the plane holds
+                    # queued work (another pool's overflow spill) a fresh
+                    # arbitration round will hand out — stay hot instead
+                    # of parking a worker against a non-empty plane
+                    cap = 2e-5
                 time.sleep(min(cap, 1e-6 * (1 << min(misses, 10))))
 
     # ------------------------------------------------------------------ task FSM

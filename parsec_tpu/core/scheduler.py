@@ -37,6 +37,7 @@ import itertools
 import random
 import sys
 import threading
+import weakref
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -52,8 +53,48 @@ class SchedulerModule:
     name = "base"
     priority = 0  # component selection priority, highest wins
 
+    #: native arbitration flavor of this policy on the scheduler plane
+    #: (native/src/ptsched.h): "wdrr" | "fifo" | "prio" | "rndsteal", or
+    #: None when the policy has no native analogue — the plane then
+    #: declines (counted in SCHED_STATS["policy_fallback"]) and every
+    #: engine keeps its private ready structure, so ``--mca sched <name>``
+    #: selects ordering UNIFORMLY across interpreted and native paths
+    #: (docs/scheduling.md has the full matrix)
+    native_policy: Optional[str] = None
+
     def install(self, context) -> None:
         self.context = context
+        self._register_py_counters()
+
+    def stats_global(self) -> Dict[str, int]:
+        """Module-WIDE queue depths (not per-stream): the ``sched.py.*``
+        registry export, so interpreted and native runs publish the same
+        shape of scheduler observability (``sched.queued`` vs
+        ``sched.py.queued``) instead of consumers hand-poking per-stream
+        stats() dicts."""
+        return {}
+
+    def _register_py_counters(self) -> None:
+        """Route this module through the unified counter registry as
+        ``sched.py.*`` (weakly bound: a finished context's module must
+        not be pinned by the process-wide registry; the latest installed
+        module wins the name, matching the one-live-context norm)."""
+        from ..utils.counters import counters
+        ref = weakref.ref(self)
+
+        def _mk(key):
+            def sample():
+                m = ref()
+                if m is None:
+                    return 0
+                try:
+                    return m.stats_global().get(key, 0)
+                except Exception:  # noqa: BLE001 — sampling never breaks
+                    return 0
+            return sample
+
+        for key in ("queued", "local_len", "system_len"):
+            counters.register(f"sched.py.{key}", sampler=_mk(key))
 
     def flow_init(self, stream) -> None:
         """Per-execution-stream initialization (ref: flow_init + barrier)."""
@@ -342,6 +383,12 @@ class _LocalQueuesBase(SchedulerModule):
         return {"local_len": len(self._local(stream)),
                 "system_len": len(self._system)}
 
+    def stats_global(self):
+        local = sum(len(q) for q in self._queues.values())
+        system = len(self._system)
+        return {"queued": local + system, "local_len": local,
+                "system_len": system}
+
     def has_local_work(self, stream) -> bool:
         return bool(len(self._local(stream)) or len(self._system))
 
@@ -356,6 +403,7 @@ class SchedLFQ(_LocalQueuesBase):
     (ref: parsec/mca/sched/lfq/sched_lfq_module.c:73, hbbuffer.c)."""
     name = "lfq"
     priority = 20
+    native_policy = "wdrr"
 
     def flow_init(self, stream) -> None:
         # bounded per-stream buffers exist to keep work stealable: with ONE
@@ -400,6 +448,7 @@ class SchedPBQ(_LocalQueuesBase):
     the system queue — hot work never leaves the owning stream
     (ref: sched_pbq, hbbuffer_push_all_by_priority)."""
     name = "pbq"
+    native_policy = "prio"
 
     flow_init = SchedLFQ.flow_init
 
@@ -421,6 +470,7 @@ class SchedLHQ(_LocalQueuesBase):
     walks it back down before crossing to other VPs
     (ref: sched_lhq_module.c, nested hbbuffers per hwloc level)."""
     name = "lhq"
+    native_policy = "wdrr"
 
     def install(self, context) -> None:
         super().install(context)
@@ -528,6 +578,7 @@ class SchedLTQ(_LocalQueuesBase):
     the victim's best heap and SPLITS it, carrying half home — related
     tasks migrate together (ref: sched_ltq_module.c + maxheap.c)."""
     name = "ltq"
+    native_policy = "prio"
 
     def flow_init(self, stream) -> None:
         with self._init_lock:
@@ -607,6 +658,7 @@ class SchedLL(_LocalQueuesBase):
     """Local LIFO: push and pop the same end (depth-first), steal the other
     (ref: sched_ll)."""
     name = "ll"
+    native_policy = "fifo"
 
     def flow_init(self, stream) -> None:
         with self._init_lock:
@@ -635,6 +687,7 @@ class SchedLLP(_LocalQueuesBase):
     priority class); no system queue; thieves take from the cold end
     (ref: sched_llp, parsec_lifo_with_prio)."""
     name = "llp"
+    native_policy = "prio"
 
     def flow_init(self, stream) -> None:
         with self._init_lock:
@@ -695,6 +748,9 @@ class _GlobalBase(SchedulerModule):
     def flow_init(self, stream) -> None:
         pass
 
+    def stats_global(self):
+        return {"queued": len(self._q)}
+
     def has_local_work(self, stream) -> bool:
         return len(self._q) > 0
 
@@ -702,6 +758,7 @@ class _GlobalBase(SchedulerModule):
 class SchedGD(_GlobalBase):
     """Global dequeue (ref: sched_gd)."""
     name = "gd"
+    native_policy = "fifo"
 
     def schedule(self, stream, tasks, distance: int = 0) -> None:
         tasks = list(tasks)
@@ -719,6 +776,7 @@ class SchedGD(_GlobalBase):
 class SchedRND(_GlobalBase):
     """Random order global queue (ref: sched_rnd)."""
     name = "rnd"
+    native_policy = "rndsteal"
 
     def install(self, context) -> None:
         super().install(context)
@@ -751,6 +809,9 @@ class _GlobalHeapBase(SchedulerModule):
     def flow_init(self, stream) -> None:
         pass
 
+    def stats_global(self):
+        return {"queued": len(self._heap)}
+
     def has_local_work(self, stream) -> bool:
         return len(self._heap) > 0
 
@@ -766,18 +827,21 @@ class SchedAP(_GlobalHeapBase):
     """Absolute priority (ref: sched_ap): depth-first (LIFO) among equal
     priorities — the freshest ready task continues the critical path."""
     name = "ap"
+    native_policy = "prio"
     tie_lifo = True
 
 
 class SchedSPQ(_GlobalHeapBase):
     """Shared priority queue (ref: sched_spq)."""
     name = "spq"
+    native_policy = "prio"
 
 
 class SchedIP(_GlobalHeapBase):
     """Inverse priority (ref: sched_ip): lowest priority first."""
     name = "ip"
     sign = 1
+    native_policy = None   # inverse priority has no native flavor
 
 
 _modules = {

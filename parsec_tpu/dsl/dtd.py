@@ -94,6 +94,14 @@ PTDTD_STATS = _LaneStats(pools_batch=0, tasks_batched=0, tasks_per_task=0,
 _BINFO_UNSET = object()
 
 
+class AdmissionBackpressure(RuntimeError):
+    """insert_task(nowait=True) on a pool past its scheduler-plane
+    admission window (--mca sched_admission_window / tp.admission_window):
+    the ready plane is protecting itself from a runaway inserter. Retry
+    later, drop the request, or insert blocking (the default) — the
+    serving-tier choice, not the runtime's."""
+
+
 def _flush_body(arr):
     """data_flush task body: force device->host materialization."""
     return np.asarray(arr)
@@ -348,6 +356,12 @@ class DTDTaskpool(Taskpool):
         self._batch_on = False
         self._batch_retired = False   # final-completion hand-back ran
         self._slots_stale = False     # quiescence sync emptied the slots
+        #: scheduler-plane pool handle (core/sched_plane.py): set when the
+        #: batch lane arms on a plane-carrying context; batch classes
+        #: register with it so their ready tasks drain by QoS weight, and
+        #: the admission window (tp.admission_window / --mca
+        #: sched_admission_window) backpressures insert_task through it
+        self._sched_pool: Optional[int] = None
         self._bbuf: List[tuple] = []
         self._batch_flush_n = max(1, min(256, self.window_size // 2))
         #: one-entry FAST-PATH cache: (fn, jit, batch, kinds|k0, cls_nid,
@@ -470,13 +484,20 @@ class DTDTaskpool(Taskpool):
             return None
         eng = getattr(ctx, "_dtd_neng", None)
         if eng is None and not getattr(ctx, "_dtd_neng_failed", False):
-            from .. import native as native_mod
-            mod = native_mod.load_ptdtd()
-            if mod is None:
-                ctx._dtd_neng_failed = True
-            else:
-                eng = ctx._dtd_neng = mod.Engine()
-                ctx._dtd_ntasks = {}
+            # serialized: two pools first-inserting from different client
+            # threads must not BOTH mint an engine (the loser's tasks
+            # would link into a chain state nobody drains)
+            with _BATCH_POOLS_LOCK:
+                eng = getattr(ctx, "_dtd_neng", None)
+                if eng is None and \
+                        not getattr(ctx, "_dtd_neng_failed", False):
+                    from .. import native as native_mod
+                    mod = native_mod.load_ptdtd()
+                    if mod is None:
+                        ctx._dtd_neng_failed = True
+                    else:
+                        ctx._dtd_ntasks = {}
+                        eng = ctx._dtd_neng = mod.Engine()
         if eng is not None:
             # progress loops drain our ready buffer even when the user
             # drives the context directly (no tp.wait()); weakly bound so
@@ -496,6 +517,15 @@ class DTDTaskpool(Taskpool):
                     and not getattr(ctx, "sched_explicit", False) \
                     and not any(d.type & DEV_TPU
                                 for d in ctx.devices.devices):
+                # an explicitly-chosen scheduler still refuses the batch
+                # lane even with the scheduler plane up: a DTD pool mixes
+                # batch-lane tasks (plane-ordered) with per-task-lane
+                # tasks (Python-queue-ordered — every prioritized or
+                # shape-ineligible insert), and the user's policy spans
+                # BOTH, which no per-lane ordering can honor
+                # (test_scheduler_policy_separation is the contract).
+                # PTG lanes are whole-pool native, so THEY honor an
+                # explicit policy through the plane's flavor instead
                 self._batch_on = True
                 from .. import native as _nm     # memoized load
                 self._tbuf = _nm.load_ptdtd().try_buffer
@@ -513,6 +543,22 @@ class DTDTaskpool(Taskpool):
                 with _BATCH_POOLS_LOCK:
                     ctx._dtd_batch_pools += 1
                 PTDTD_STATS["pools_batch"] += 1
+                # scheduler plane (ISSUE 9): bind the engine (idempotent —
+                # one plane per context) and register this pool's QoS
+                # identity; batch classes then route ready tasks through
+                # the shared plane, so N concurrent DTD pools drain by
+                # DRR weight and the admission window gains teeth
+                plane = getattr(ctx, "sched_plane", None)
+                if plane is not None:
+                    try:
+                        eng.sched_bind(plane.capsule)
+                        h = plane.register_pool(
+                            self.name, plane.KIND_PTDTD,
+                            weight=getattr(self, "qos_weight", None),
+                            window=getattr(self, "admission_window", None))
+                        self._sched_pool = h if h >= 0 else None
+                    except Exception:  # noqa: BLE001 — private ready path
+                        self._sched_pool = None
                 # tile payload slots sync back into tile.data when the
                 # pool completes, even when the user never calls wait().
                 # CHAIN any prior hook — compound stages and recursive
@@ -650,7 +696,8 @@ class DTDTaskpool(Taskpool):
             cb = self._mk_batch_callback(tc, key)
             nid = self._neng.register_class(
                 cb, key, [a & 0x3 for a in flow_accesses],
-                self._batch_retire)
+                self._batch_retire,
+                -1 if self._sched_pool is None else self._sched_pool)
             reg[key] = nid
         return (nid, tuple(kinds))
 
@@ -760,6 +807,14 @@ class DTDTaskpool(Taskpool):
         with _BATCH_POOLS_LOCK:
             self.ctx._dtd_batch_pools -= 1
         self._release_native()
+        if self._sched_pool is not None:
+            # free the plane slot AFTER release_pool cleared the classes'
+            # pool routing (a released class must never route to a slot
+            # another pool may reuse)
+            plane = getattr(self.ctx, "sched_plane", None)
+            if plane is not None:
+                plane.unregister_pool(self._sched_pool)
+            self._sched_pool = None
         if self.ctx._ntrace is not None:
             # ring lifecycle (quiescence): land this pool's in-lane events
             # now — the engine outlives the pool, but a dumped trace must
@@ -945,9 +1000,43 @@ class DTDTaskpool(Taskpool):
                 return
             time.sleep(50e-6)   # another user thread is draining
 
+    def _admission_stall(self) -> None:
+        """Admission backpressure (ISSUE 9): the scheduler plane reported
+        this pool past its admission window (in-flight inserted-but-not-
+        completed tasks > --mca sched_admission_window / tp.admission_
+        window), so the inserting thread HELPS DRAIN until the pool is
+        back under — a runaway client thread saturates the ingest budget
+        instead of OOMing the ready plane. Same discipline as
+        _window_stall: never blocks inside a task body (recursive inserts
+        overshoot, bounded by the DAG's fan-out), one elected drainer."""
+        h = self._sched_pool
+        if h is None:
+            return
+        plane = self.ctx.sched_plane
+        if plane is None or not plane.over_window(h):
+            return
+        if self.ctx.in_progress_loop():
+            return              # mid-body insert: never block flow control
+        self._flush_ready()
+        plane.count_stall(h)
+        self.ctx.start()
+        while plane.over_window(h):
+            if self.ctx._error is not None or self._batch_retired:
+                return
+            if self._stall_lock.acquire(blocking=False):
+                try:
+                    self.ctx._progress_loop(
+                        self.ctx.streams[0],
+                        until=lambda: not plane.over_window(h))
+                finally:
+                    self._stall_lock.release()
+                return
+            time.sleep(50e-6)   # another user thread is draining
+
     def insert_task(self, fn: Callable, *args, priority: int = 0,
                     where: int = DEV_ALL, name: Optional[str] = None,
-                    jit: bool = True, batch: bool = False) -> Optional[DTDTask]:
+                    jit: bool = True, batch: bool = False,
+                    nowait: bool = False) -> Optional[DTDTask]:
         """parsec_dtd_insert_task (ref: insert_function.c:3617).
 
         ``args``: ``(tile, access)`` tuples become data flows; anything else
@@ -972,7 +1061,23 @@ class DTDTaskpool(Taskpool):
         body with by-value args), takes the per-task path and returns the
         task. Buffered inserts flush at window boundaries, at wait/close,
         and whenever a progress loop starves.
+
+        Admission backpressure: past the scheduler plane's per-pool
+        window the insert BLOCKS (helping drain) — or raises
+        :class:`AdmissionBackpressure` with ``nowait=True``, the
+        serving-tier "shed load instead of queueing" contract. The window
+        is a soft limit: buffered-but-unflushed specs (at most the flush
+        threshold) do not count against it.
         """
+        if nowait and self._sched_pool is not None:
+            plane = self.ctx.sched_plane
+            if plane is not None and plane.over_window(self._sched_pool):
+                from ..core.sched_plane import SCHED_STATS
+                SCHED_STATS["admission_rejects"] += 1
+                raise AdmissionBackpressure(
+                    f"taskpool {self.name!r} over its admission window "
+                    f"(in-flight tasks > configured "
+                    f"sched_admission_window)")
         # batch-lane fast path: NO lock — the whole validate+spec-build+
         # buffer-append collapses into one C call (native try_buffer); the
         # list append it performs is GIL-atomic. A 0 return (unknown fn,
@@ -985,11 +1090,15 @@ class DTDTaskpool(Taskpool):
                 if r == 2:      # flush threshold reached
                     self._flush_batch()
                     self._window_stall()
+                    if not nowait:
+                        self._admission_stall()
                 return None
         with self._insert_lock:
             task = self._insert_task_locked(fn, args, priority, where, name,
                                             jit, batch)
         self._window_stall()
+        if not nowait:
+            self._admission_stall()
         return task
 
     def _insert_task_locked(self, fn: Callable, args, priority: int,

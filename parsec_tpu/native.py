@@ -123,41 +123,54 @@ def available() -> bool:
 _ptdtd_mod = [None, False]   # [module, attempted]
 _ptexec_mod = [None, False]
 _ptcomm_mod = [None, False]
+_ptsched_mod = [None, False]
 
 
 def _load_pyext(stem: str, cache):
     """Load a CPython extension (built by native/Makefile or installed in
-    the wheel), memoized in ``cache`` ([module, attempted])."""
+    the wheel), memoized in ``cache`` ([module, attempted]).
+
+    ``attempted`` is published only AFTER the load finished (inside the
+    lock): the unlocked fast check races the loader, and publishing it
+    up front let a second thread observe attempted=True with the module
+    still None — it then recorded a permanent "native unavailable"
+    (found by the serving bench's concurrent first-inserts, where N
+    client threads hit the first load simultaneously)."""
     if cache[1]:
         return cache[0]
     with _lib_lock:
         if cache[1]:
             return cache[0]
-        cache[1] = True
-        if not mca.get("native_enabled", True):
-            return None
-        import importlib.util
-        import sysconfig
-        # installed wheel first; else the in-tree build. Exact ABI-tagged
-        # filename of the RUNNING interpreter — a wildcard could load a
-        # stale extension built against another Python
-        so = _installed_so(stem)
-        if so is None:
-            so = os.path.join(_NATIVE_DIR, "build",
-                              stem + sysconfig.get_config_var("EXT_SUFFIX"))
-            if not os.path.exists(so) and not (_build()
-                                               and os.path.exists(so)):
-                return None
         try:
-            spec = importlib.util.spec_from_file_location(
-                f"parsec_tpu.{stem}", so)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            cache[0] = mod
-            output.debug_verbose(1, "native", f"{stem} loaded from {so}")
-        except Exception as e:  # noqa: BLE001
-            output.debug_verbose(1, "native", f"{stem} load failed: {e}")
-        return cache[0]
+            if not mca.get("native_enabled", True):
+                return None
+            import importlib.util
+            import sysconfig
+            # installed wheel first; else the in-tree build. Exact
+            # ABI-tagged filename of the RUNNING interpreter — a wildcard
+            # could load a stale extension built against another Python
+            so = _installed_so(stem)
+            if so is None:
+                so = os.path.join(
+                    _NATIVE_DIR, "build",
+                    stem + sysconfig.get_config_var("EXT_SUFFIX"))
+                if not os.path.exists(so) and not (_build()
+                                                   and os.path.exists(so)):
+                    return None
+            try:
+                spec = importlib.util.spec_from_file_location(
+                    f"parsec_tpu.{stem}", so)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                cache[0] = mod
+                output.debug_verbose(1, "native",
+                                     f"{stem} loaded from {so}")
+            except Exception as e:  # noqa: BLE001
+                output.debug_verbose(1, "native",
+                                     f"{stem} load failed: {e}")
+            return cache[0]
+        finally:
+            cache[1] = True
 
 
 def load_ptdtd():
@@ -186,6 +199,15 @@ def load_ptcomm():
     protocol, and ingests activations straight into the ptexec/ptdtd
     ready structures without the GIL (docs/native_exec.md)."""
     return _load_pyext("_ptcomm", _ptcomm_mod)
+
+
+def load_ptsched():
+    """The CPython-extension scheduler plane (native/src/ptsched.cpp), or
+    None. Per-worker bounded hot queues with cross-worker steal-half,
+    per-pool overflow heaps, weighted deficit-round-robin arbitration and
+    admission windows — the shared ready plane the ptexec/ptdtd engines
+    drain through when a Context arms it (docs/scheduling.md)."""
+    return _load_pyext("_ptsched", _ptsched_mod)
 
 
 class NativeDepTable:

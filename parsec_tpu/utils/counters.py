@@ -102,6 +102,7 @@ def install_native_counters() -> None:
     ``trace.*``) so :mod:`parsec_tpu.tools.live_view` and the SDE-style
     snapshot export see the lanes. Idempotent."""
     from ..comm import native as _cnative        # lazy: avoid import cycles
+    from ..core import sched_plane as _sp
     from ..dsl import dtd as _dtd
     from ..dsl.ptg import compiler as _ptg
     from . import native_trace as _nt
@@ -112,13 +113,19 @@ def install_native_counters() -> None:
 
     for stats, prefix in ((_ptg.PTEXEC_STATS, "ptexec"),
                           (_dtd.PTDTD_STATS, "ptdtd"),
-                          (_cnative.PTCOMM_STATS, "ptcomm")):
+                          (_cnative.PTCOMM_STATS, "ptcomm"),
+                          (_sp.SCHED_STATS, "sched")):
         for key in stats:
             counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
     # the comm lane's C-side wire counters (summed across live lanes)
     for key in _cnative.COMM_COUNTER_KEYS:
         counters.register(f"ptcomm.{key}",
                           sampler=_cnative.comm_counter_sampler(key))
+    # the scheduler plane's C-side counters (summed across live planes):
+    # steals, spills, served, queued, admission stalls — ISSUE 9
+    for key in _sp.PLANE_COUNTER_KEYS:
+        counters.register(f"sched.{key}",
+                          sampler=_sp.plane_counter_sampler(key))
     counters.register(TRACE_EVENTS_DROPPED, sampler=_nt.total_dropped)
     counters.register(TRACE_EVENTS_NATIVE, sampler=_nt.total_landed)
     counters.register(PTEXEC_SLOTS_RETIRED)   # accumulator: lane finalize adds

@@ -47,6 +47,7 @@ HIST_NAMES: Dict[str, Tuple[str, ...]] = {
     "ptexec": ("exec_ns", "ready_wait_ns"),
     "ptdtd": ("exec_ns", "ready_wait_ns"),
     "ptcomm": ("rdv_rtt_ns", "act_queue_ns"),
+    "sched": ("queue_ns",),     # plane push->pop wait (ISSUE 9)
 }
 
 

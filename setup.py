@@ -47,6 +47,8 @@ setup(
         Extension("parsec_tpu._ptcomm", ["native/src/ptcomm.cpp"],
                   extra_compile_args=["-O3", "-std=c++17"],
                   libraries=["rt"]),
+        Extension("parsec_tpu._ptsched", ["native/src/ptsched.cpp"],
+                  extra_compile_args=["-O3", "-std=c++17"]),
         Extension("parsec_tpu._ptcore", ["native/src/ptcore.cpp"],
                   extra_compile_args=["-O3", "-std=c++17"]),
     ],
