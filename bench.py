@@ -1112,6 +1112,68 @@ def main() -> None:
         log(f"2-rank comm lane leg failed: {e}")
     persist("after comm lane legs")
 
+    # ---- native device lane (ISSUE 10): the capture-regression tracker ---
+    # `gemm_gflops_sched_native` (PTG [type=TPU] bodies through ptexec +
+    # ptdev: async dispatch, event retirement, early-push stage-in) vs
+    # `gemm_gflops_captured` (the same problem as ONE XLA executable) on
+    # one host device, plus the measured transfer/compute overlap
+    # engagement — the 89.7-vs-109.8 sched-vs-captured gap (BENCH r03-r05,
+    # next to `potrf_captured_gflops`) becomes a tracked ratio instead of
+    # folklore. Runs in a subprocess so the over_cpu test mode cannot leak
+    # into this process's device registry.
+    try:
+        denv = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "zone_bench.py"),
+             "--device-lane"],
+            capture_output=True, text=True, timeout=900, env=denv)
+        assert p.returncode == 0, p.stderr[-500:]
+        dl = json.loads(p.stdout.strip().splitlines()[-1])
+        if dl.get("gemm_native_engaged"):
+            for k in ("gemm_gflops_sched_native", "gemm_gflops_captured",
+                      "gemm_sched_native_vs_captured",
+                      "device_overlap_pct_native"):
+                if k in dl:
+                    results[k] = dl[k]
+            if dl.get("gemm_cpu_artifact"):
+                results["device_lane_note"] = (
+                    "over_cpu device: XLA-CPU has no async device, so "
+                    "every dispatch runs synchronously and the captured "
+                    "single executable structurally wins; the ratio is "
+                    "the tracked signal, overlap_pct shows the push/exec "
+                    "pipeline engaging")
+            log(f"device lane GEMM: sched-native "
+                f"{dl.get('gemm_gflops_sched_native')} vs captured "
+                f"{dl.get('gemm_gflops_captured')} GFLOP/s "
+                f"(ratio {dl.get('gemm_sched_native_vs_captured')}, "
+                f"overlap {dl.get('device_overlap_pct_native')}%)")
+        else:
+            log("device lane leg: lane did not engage; native keys withheld")
+    except Exception as e:  # noqa: BLE001 — degrade, keep all other keys
+        log(f"device lane leg failed: {e}")
+    # the zone/coh-table leg is independent of the GEMM leg: its keys
+    # must survive a device-lane failure (degrade-and-continue per leg)
+    try:
+        zp = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "zone_bench.py")],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     ZONE_BENCH_OPS="100000"))
+        assert zp.returncode == 0, zp.stderr[-500:]
+        zl = json.loads(zp.stdout.strip().splitlines()[-1])
+        results["zone_malloc_ops_per_sec"] = zl["value"]
+        if zl.get("coh_table"):
+            results["coh_table_ops_per_sec"] = \
+                zl["coh_table"]["ops_per_sec"]
+        log(f"zone heap: {zl['value']:,} alloc/free ops/s; coh table: "
+            f"{zl.get('coh_table', {}).get('ops_per_sec', 0):,} "
+            f"stage-in decisions/s")
+    except Exception as e:  # noqa: BLE001 — degrade, keep all other keys
+        log(f"zone bench leg failed: {e}")
+    persist("after device lane legs")
+
     # per-dispatch protocol cost of this chip path (diagnostic: on the
     # tunneled chip this is ~1000x a local PJRT dispatch and bounds any
     # task-runtime's DAG rate; recorded so the GFLOP/s numbers are readable)

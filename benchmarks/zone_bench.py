@@ -1,13 +1,28 @@
 #!/usr/bin/env python3
-"""Zone-allocator throughput microbench (ref:
-tests/runtime/cuda/zonemalloc_benchmark.c — the reference measures its GPU
-zone-malloc under random alloc/free churn; BASELINE.md lists the harness).
+"""Zone-allocator + device-lane benchmark (ISSUE 10).
 
-Drives BOTH zone backends through the same randomized alloc/free trace —
-the pure-Python `utils/zone_malloc.ZoneMalloc` (the device-module heap
-manager) and the native C++ `pt_zone` via `native.NativeZone` — with a
-working set of live blocks, random sizes, random replacement; reports
-operations/second per backend. Prints one JSON line.
+Three legs, all on one host device (``--mca device_tpu_over_cpu``), so
+CPU-only CI exercises the full machinery:
+
+* **zone** (default) — the zone-allocator churn microbench (ref:
+  tests/runtime/cuda/zonemalloc_benchmark.c): both zone backends through
+  the same randomized alloc/free trace, plus the native CohTable through
+  a randomized stage-in/evict trace (the residency-policy hot path).
+* **device** (``--device-lane``) — the capture-regression tracker: a
+  PTG tiled GEMM with ``[type=TPU]`` bodies through the NATIVE path
+  (ptexec + ptdev: async dispatch, event retirement, early-push
+  stage-in) vs the same problem whole-DAG CAPTURED (DTD capture) —
+  ``gemm_gflops_sched_native`` vs ``gemm_gflops_captured``, with
+  ``device_overlap_pct_native`` measured from the lane's overlap
+  counters. bench.py embeds these as real keys next to
+  ``potrf_captured_gflops`` so the 89.7-vs-109.8 regression (BENCH
+  r03-r05) is tracked, not folklore.
+* **gate** (``--ci-gate``) — the ci.sh engagement gate: a mixed
+  CPU+TPU-body pool must keep native engagement end-to-end (zero
+  ``pools_fallback`` on both lanes, nonzero ``ptdev.retired``, zero
+  ``dev_bad``/callback errors, zero coherency violations in the table).
+
+Prints one JSON line per invocation.
 """
 
 import json
@@ -18,6 +33,27 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+
+_GEMM_SRC = """
+%global MT
+%global KT
+%global descA
+%global descB
+%global descC
+
+GEMM(m, n, k)
+  m = 0 .. MT-1
+  n = 0 .. MT-1
+  k = 0 .. KT-1
+  : descC(m, n)
+  READ A <- descA(m, k)
+  READ B <- descB(k, n)
+  RW   C <- (k == 0) ? descC(m, n) : C GEMM(m, n, k-1)
+       -> (k < KT-1) ? C GEMM(m, n, k+1) : descC(m, n)
+BODY [type=TPU]
+  C = C + jnp.dot(A, B, preferred_element_type=jnp.float32)
+END
+"""
 
 
 def drive(alloc, free, n_ops: int, rng, max_live: int = 256,
@@ -44,6 +80,188 @@ def drive(alloc, free, n_ops: int, rng, max_live: int = 256,
     return {"ops_per_sec": round((allocs + frees) / dt),
             "allocs": allocs, "frees": frees, "alloc_failures": failures,
             "wall_s": round(dt, 4)}
+
+
+def _mk_gemm_mats(prefix: str, n: int, ts: int, rng):
+    from parsec_tpu.data.matrix import TiledMatrix
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A = TiledMatrix(prefix + "A", n, n, ts, ts)
+    B = TiledMatrix(prefix + "B", n, n, ts, ts)
+    C = TiledMatrix(prefix + "C", n, n, ts, ts)
+    A.fill(lambda m, k: a[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    B.fill(lambda m, k: b[m*ts:(m+1)*ts, k*ts:(k+1)*ts])
+    C.fill(lambda m, k: np.zeros((ts, ts), np.float32))
+    return A, B, C, a, b
+
+
+def _run_gemm_native(ctx, prog, A, B, C, n, ts):
+    tp = prog.instantiate(ctx, globals={"MT": n // ts, "KT": n // ts},
+                          collections={"descA": A, "descB": B, "descC": C},
+                          name=f"zb-gemm-{time.monotonic_ns()}")
+    t0 = time.perf_counter()
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=300)
+    C.to_dense()                      # force completion of every tile
+    return time.perf_counter() - t0, tp
+
+
+def device_lane_leg(out: dict) -> None:
+    """gemm_gflops_sched_native vs gemm_gflops_captured on one host
+    device, + device_overlap_pct_native from the lane counters."""
+    from parsec_tpu.utils import mca
+    mca.set("device_tpu_over_cpu", True)
+    import parsec_tpu as pt
+    from parsec_tpu.device.native import PTDEV_STATS
+    from parsec_tpu.dsl.dtd import DTDTaskpool
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS, compile_ptg
+
+    n, ts = int(os.environ.get("ZB_GEMM_N", "512")), \
+        int(os.environ.get("ZB_GEMM_TS", "128"))
+    reps = 3
+    flops = 2.0 * n * n * n
+    rng = np.random.default_rng(17)
+    ctx = pt.Context(nb_cores=1)
+    try:
+        prog = compile_ptg(_GEMM_SRC, "zb-gemm")
+        A, B, C, a, b = _mk_gemm_mats("zbN", n, ts, rng)
+        snap = PTEXEC_STATS.snapshot()
+        dsnap = PTDEV_STATS.snapshot()
+        _w, _tp = _run_gemm_native(ctx, prog, A, B, C, n, ts)   # warm/compile
+        best = min(_run_gemm_native(
+            ctx, prog, *_mk_gemm_mats(f"zbN{r}", n, ts, rng)[:3],
+            n, ts)[0] for r in range(reps))
+        delta = PTEXEC_STATS.delta(snap)
+        ddelta = PTDEV_STATS.delta(dsnap)
+        engaged = delta["pools_fallback"] == 0 and \
+            delta["pools_device"] >= 1 and ddelta["pools_fallback"] == 0
+        out["gemm_gflops_sched_native"] = round(flops / 1e9 / best, 2)
+        out["gemm_native_engaged"] = engaged
+        lane = ctx._ptdev
+        if lane and lane is not True:
+            ls = lane.clane.stats()
+            out["device_overlap_pct_native"] = round(
+                100.0 * ls["overlap_hits"] / max(1, ls["dispatch_batches"]),
+                1)
+            out["ptdev_stats"] = ls
+
+        # the captured leg: the same problem as ONE XLA executable
+        def run_captured(tag):
+            Ac, Bc, Cc, _a, _b = _mk_gemm_mats(tag, n, ts, rng)
+            cap = DTDTaskpool(ctx, f"zb-cap-{tag}", capture=True)
+            from parsec_tpu.ops.gemm import insert_gemm_tasks
+            t0 = time.perf_counter()
+            insert_gemm_tasks(cap, Ac, Bc, Cc, batch_k=True)
+            cap.wait()
+            cap.close()
+            Cc.to_dense()
+            return time.perf_counter() - t0
+
+        run_captured("zbCw")          # compile
+        cap_best = min(run_captured(f"zbC{r}") for r in range(reps))
+        out["gemm_gflops_captured"] = round(flops / 1e9 / cap_best, 2)
+        out["gemm_sched_native_vs_captured"] = round(
+            out["gemm_gflops_sched_native"] / out["gemm_gflops_captured"],
+            3)
+        # honest container note: on XLA-CPU there is no asynchronous
+        # device — every "dispatch" executes synchronously on the calling
+        # thread, so the per-task issue cost the scheduler path pays is
+        # pure overhead while the captured single executable pays it
+        # once. On real accelerator hardware the issue cost overlaps the
+        # in-flight compute (device_overlap_pct_native measures exactly
+        # that engagement). The RATIO is the tracked regression signal;
+        # absolute GFLOP/s here are a CPU artifact.
+        out["gemm_cpu_artifact"] = True
+    finally:
+        ctx.fini()
+        mca.params.unset("device_tpu_over_cpu")
+
+
+def coh_trace_leg(out: dict, n_ops: int) -> None:
+    """Randomized stage-in/evict churn through the native CohTable (the
+    residency-policy hot path the device module consults per stage-in)."""
+    from parsec_tpu import native as native_mod
+    mod = native_mod.load_ptdev()
+    if mod is None:
+        out["coh_table"] = None
+        return
+    t = mod.CohTable(64 << 20)
+    rng = np.random.default_rng(23)
+    keys = rng.integers(1, 4096, size=n_ops)
+    sizes = rng.integers(1024, 1 << 20, size=n_ops)
+    t0 = time.perf_counter()
+    for i in range(n_ops):
+        t.stage_in(int(keys[i]), int(sizes[i]), int(i % 7))
+    dt = time.perf_counter() - t0
+    st = t.stats()
+    out["coh_table"] = {"ops_per_sec": round(n_ops / dt),
+                        "hits": st["coh_hits"], "misses": st["coh_misses"],
+                        "evictions": st["evictions"]}
+
+
+def ci_gate() -> None:
+    """ci.sh device-lane engagement gate (CPU-only CI, over_cpu mode)."""
+    from parsec_tpu.utils import mca
+    mca.set("device_tpu_over_cpu", True)
+    import parsec_tpu as pt
+    from parsec_tpu.device.native import PTDEV_STATS
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS, compile_ptg
+
+    n, ts = 128, 32
+    rng = np.random.default_rng(5)
+    ctx = pt.Context(nb_cores=1)
+    snap = PTEXEC_STATS.snapshot()
+    dsnap = PTDEV_STATS.snapshot()
+    prog = compile_ptg(_GEMM_SRC, "gate-gemm")
+    A, B, C, a, b = _mk_gemm_mats("gate", n, ts, rng)
+    _w, tp = _run_gemm_native(ctx, prog, A, B, C, n, ts)
+    err = float(np.abs(C.to_dense() - a @ b).max())
+    delta = PTEXEC_STATS.delta(snap)
+    ddelta = PTDEV_STATS.delta(dsnap)
+    assert err < 1e-2, f"device-lane GEMM wrong: max err {err}"
+    assert tp._ptexec_state is not None, "pool fell off the execution lane"
+    assert delta["pools_fallback"] == 0 and delta["pools_device"] == 1, delta
+    assert ddelta["pools_fallback"] == 0 and \
+        ddelta["pools_engaged"] == 1, ddelta
+    lane = ctx._ptdev
+    assert lane, "no device lane created"
+    gs = tp._ptexec_state["graph"].dev_stats()
+    nt = (n // ts) ** 3
+    assert gs["dev_tx"] == gs["dev_done"] == nt and gs["dev_bad"] == 0, gs
+    ls = lane.clane.stats()
+    assert ls["retired"] >= nt and ls["cb_errors"] == 0, ls
+    assert lane.failed() is None
+    # zero coherency violations. A valid table entry may legally trail
+    # data.version (a SHARED replica goes stale when the HOST takes the
+    # write — MOESI); the violations are (a) the table claiming a version
+    # AHEAD of the data's truth, (b) the table and the Python device copy
+    # disagreeing about what is resident at which version.
+    dev = lane.device
+    violations = []
+    for M in (A, B, C):
+        for m in range(M.mt):
+            for nn in range(M.nt):
+                d = M.data_of(m, nn)
+                st = dev._ncoh.state(dev.res_key(d)) \
+                    if dev._ncoh is not None else None
+                if st is None or st[0] == 0:
+                    continue
+                if st[1] > (d.version & 0xFFFFFFFF):
+                    violations.append(("ahead", M.name, m, nn, st[1],
+                                       d.version))
+                dcopy = d.get_copy(dev.device_index)
+                if dcopy is None or dcopy.payload is None or \
+                        dcopy.version != st[1]:
+                    violations.append(("mismatch", M.name, m, nn, st[1],
+                                       getattr(dcopy, "version", None)))
+    assert not violations, f"coherency violations: {violations[:5]}"
+    ctx.fini()
+    mca.params.unset("device_tpu_over_cpu")
+    print(json.dumps({"device_lane_gate": "OK", "tasks": nt,
+                      "ptexec": delta, "ptdev": ddelta,
+                      "lane": {k: ls[k] for k in
+                               ("retired", "overlap_hits",
+                                "dispatch_batches")}}))
 
 
 def main() -> None:
@@ -78,8 +296,19 @@ def main() -> None:
     else:
         out["value"] = out["python"]["ops_per_sec"]
         out["native"] = None
+    coh_trace_leg(out, min(n_ops, 100000))
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if "--ci-gate" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        ci_gate()
+    elif "--device-lane" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = {"metric": "device-lane-gemm", "unit": "GFLOP/s"}
+        device_lane_leg(out)
+        out["value"] = out.get("gemm_gflops_sched_native", 0.0)
+        print(json.dumps(out))
+    else:
+        main()

@@ -19,7 +19,8 @@ assert native.load_ptdtd() is not None, "_ptdtd built but failed to load"
 assert native.load_ptexec() is not None, "_ptexec built but failed to load"
 assert native.load_ptcomm() is not None, "_ptcomm built but failed to load"
 assert native.load_ptsched() is not None, "_ptsched built but failed to load"
-print("native artifacts OK (ptcore, ptdtd, ptexec, ptcomm, ptsched)")
+assert native.load_ptdev() is not None, "_ptdev built but failed to load"
+print("native artifacts OK (ptcore, ptdtd, ptexec, ptcomm, ptsched, ptdev)")
 EOF
 
 echo "== no compiled artifacts tracked/staged =="
@@ -114,6 +115,14 @@ echo "== scheduler plane engagement smoke (multi-pool ptsched) =="
 # weighting the drain, and a LONE pool staying on its private ready
 # structure (the structural form of the single-pool overhead contract)
 JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/serving.py --ci-gate
+
+echo "== native device lane engagement smoke (over_cpu) =="
+# ISSUE 10: a TPU-bodied pool must keep native engagement END TO END on
+# CPU-only CI (device_tpu_over_cpu mode): zero pools_fallback on both the
+# execution and device lanes, every device task dispatched AND retired
+# through ptdev (nonzero ptdev.retired, zero dev_bad / callback errors),
+# zero coherency violations in the C residency table, bit-correct GEMM
+JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/zone_bench.py --ci-gate
 
 echo "== native comm lane engagement smoke (2 ranks) =="
 # same contract as the execution-lane gates: assert ENGAGEMENT, not

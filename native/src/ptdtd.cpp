@@ -62,6 +62,7 @@
 #include <vector>
 
 #include "ptcomm_iface.h"
+#include "ptdev_iface.h"
 #include "pthist.h"
 #include "ptrace_ring.h"
 #include "ptsched.h"
@@ -115,6 +116,9 @@ struct ClassRec {
     int32_t pool = -1;                 // scheduler-plane pool handle (the
                                        // QoS identity of the owning
                                        // taskpool; -1 = private ready)
+    int32_t device = 0;                // 1 = device-bodied: ready tasks
+                                       // surface onto the ptdev lane
+                                       // (dev_bind) instead of `ready`
 };
 
 struct Engine {
@@ -146,6 +150,17 @@ struct Engine {
     // pool (plane off, pre-plane pools) keep the private vector
     ptsched::Plane *splane;
     PyObject *sched_cap;
+    // device lane (dev_bind, ISSUE 10): ready tasks of device-marked
+    // classes surface onto the ptdev lane's MPSC queue (GIL-free) and
+    // come back through dev_retire() — wired at the engine level; the
+    // Python DTD front end keeps device pools on the interpreted device
+    // module this PR (counted ineligible), the ptcomm precedent
+    bool dev_bound;
+    uint32_t dev_pool;
+    PtDevSubmitVtbl dsend;
+    std::atomic<int64_t> dev_tx;
+    std::atomic<int64_t> dev_done;
+    std::atomic<int64_t> dev_bad;
 };
 
 PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
@@ -169,6 +184,12 @@ PyObject *engine_new(PyTypeObject *type, PyObject *, PyObject *) {
     new (&self->hist) std::atomic<pthist::State<N_HISTS> *>(nullptr);
     self->splane = nullptr;
     self->sched_cap = nullptr;
+    self->dev_bound = false;
+    self->dev_pool = 0;
+    self->dsend = PtDevSubmitVtbl{0, nullptr, nullptr};
+    new (&self->dev_tx) std::atomic<int64_t>(0);
+    new (&self->dev_done) std::atomic<int64_t>(0);
+    new (&self->dev_bad) std::atomic<int64_t>(0);
     if (!self->mu || !self->tasks || !self->tiles || !self->classes ||
         !self->flow_tile || !self->flow_acc || !self->ready ||
         !self->rsurf) {
@@ -355,6 +376,16 @@ void complete_locked(Engine *self, int64_t tid,
         if (--sr.deps_remaining == 0) {
             if (sr.cls >= 0) {
                 sr.ready_ns = now;
+                if (self->dev_bound &&
+                    (*self->classes)[(size_t)sr.cls].device &&
+                    s <= INT32_MAX) {
+                    // device-bodied class: surface onto the ptdev lane
+                    // (lock-free submit; mu-held is fine, never blocks)
+                    self->dsend.submit(self->dsend.dev, self->dev_pool,
+                                       (int32_t)s);
+                    self->dev_tx.fetch_add(1, std::memory_order_relaxed);
+                    continue;
+                }
                 int32_t ph = plane_pool_of(self, sr.cls, s);
                 if (ph >= 0) {
                     if (planeq) {
@@ -530,8 +561,10 @@ PyObject *engine_register_class(PyObject *obj, PyObject *args) {
     PyObject *cb, *argmap_o, *accs_o, *retire = Py_None;
     int pool = -1;     // scheduler-plane pool handle of the owning
                        // taskpool (QoS routing; -1 = private ready)
-    if (!PyArg_ParseTuple(args, "OOO|Oi", &cb, &argmap_o, &accs_o, &retire,
-                          &pool))
+    int device = 0;    // 1 = device-bodied (ready tasks surface onto the
+                       // ptdev lane once dev_bind armed it)
+    if (!PyArg_ParseTuple(args, "OOO|Oii", &cb, &argmap_o, &accs_o, &retire,
+                          &pool, &device))
         return nullptr;
     if (!PyCallable_Check(cb)) {
         PyErr_SetString(PyExc_TypeError, "callback must be callable");
@@ -580,6 +613,7 @@ PyObject *engine_register_class(PyObject *obj, PyObject *args) {
         cr.retire = retire;
     }
     cr.pool = (pool >= 0 && pool < ptsched::MAX_POOLS) ? pool : -1;
+    cr.device = device ? 1 : 0;
     Py_ssize_t cls;
     {
         std::lock_guard<std::mutex> lk(*self->mu);
@@ -694,10 +728,17 @@ PyObject *engine_insert_many(PyObject *obj, PyObject *arg) {
             // guard. 0 deps -> straight onto the internal ready structure
             if (--rec.deps_remaining == 0) {
                 rec.ready_ns = h_now;
-                if (ph >= 0)
+                if (self->dev_bound &&
+                    (*self->classes)[(size_t)sp.cls].device &&
+                    tid <= INT32_MAX) {
+                    self->dsend.submit(self->dsend.dev, self->dev_pool,
+                                       (int32_t)tid);
+                    self->dev_tx.fetch_add(1, std::memory_order_relaxed);
+                } else if (ph >= 0) {
                     planeq.emplace_back(ph, (int32_t)tid);
-                else
+                } else {
                     self->ready->push_back(tid);
+                }
             }
         }
     }
@@ -1335,6 +1376,97 @@ PyObject *engine_ingest(PyObject *obj, PyObject *arg) {
     Py_RETURN_NONE;
 }
 
+// ------------------------------------------------------- device lane bind
+
+// GIL-free entry the ptdev manager thread calls through the
+// PtDevRetireVtbl capsule: device task `tid` completed (its outputs were
+// already landed into the tile payload slots by the manager's poll
+// callback, under the GIL, BEFORE this call). Runs the release walk:
+// newly-ready device-class successors surface back onto the lane inside
+// complete_locked, batch-lane successors join the internal ready
+// structure, and per-task-lane successors park in rsurf for the next
+// drain_ready — the same three-way routing a batch completion does.
+void dtd_dev_retire_c(void *obj, int32_t tid) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (tid < 0 || (size_t)tid >= self->tasks->size() || !self->dev_bound) {
+        self->dev_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    TaskRec &rec = (*self->tasks)[(size_t)tid];
+    if (rec.completed || rec.cls < 0 ||
+        !(*self->classes)[(size_t)rec.cls].device) {
+        self->dev_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    complete_locked(self, tid, *self->rsurf,
+                    hist_of(self) ? ptrace_ring::now_ns() : 0);
+    self->batch_done++;
+    self->dev_done.fetch_add(1, std::memory_order_relaxed);
+}
+
+void dtd_dev_retire_capsule_free(PyObject *cap) {
+    std::free(PyCapsule_GetPointer(cap, PTDEV_RETIRE_CAPSULE));
+}
+
+PyObject *engine_dev_retire_capsule(PyObject *obj, PyObject *) {
+    PtDevRetireVtbl *v =
+        static_cast<PtDevRetireVtbl *>(std::malloc(sizeof(PtDevRetireVtbl)));
+    if (!v) return PyErr_NoMemory();
+    v->abi = PTDEV_ABI;
+    v->obj = obj;
+    v->retire = dtd_dev_retire_c;
+    PyObject *cap = PyCapsule_New(v, PTDEV_RETIRE_CAPSULE,
+                                  dtd_dev_retire_capsule_free);
+    if (!cap) std::free(v);
+    return cap;
+}
+
+// dev_bind(submit_capsule, dev_pool) — arm the device lane: ready tasks
+// of device-marked classes (register_class(..., device=1)) surface onto
+// the ptdev lane from this point on. Bind BEFORE inserting any task of a
+// device class — an already-ready device task would otherwise sit in the
+// internal ready structure and run through drain_ready's CPU callback.
+PyObject *engine_dev_bind(PyObject *obj, PyObject *args) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    PyObject *cap;
+    unsigned int pool;
+    if (!PyArg_ParseTuple(args, "OI", &cap, &pool)) return nullptr;
+    PtDevSubmitVtbl *sv = static_cast<PtDevSubmitVtbl *>(
+        PyCapsule_GetPointer(cap, PTDEV_SUBMIT_CAPSULE));
+    if (!sv) return nullptr;
+    if (sv->abi != PTDEV_ABI) {
+        PyErr_SetString(PyExc_RuntimeError, "ptdev ABI mismatch");
+        return nullptr;
+    }
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (self->dev_bound) {
+        PyErr_SetString(PyExc_RuntimeError, "engine already dev-bound");
+        return nullptr;
+    }
+    self->dsend = *sv;
+    self->dev_pool = pool;
+    self->dev_bound = true;
+    Py_RETURN_NONE;
+}
+
+PyObject *engine_dev_retire(PyObject *obj, PyObject *arg) {
+    long long tid = PyLong_AsLongLong(arg);
+    if (tid == -1 && PyErr_Occurred()) return nullptr;
+    dtd_dev_retire_c(obj, (int32_t)tid);
+    Py_RETURN_NONE;
+}
+
+PyObject *engine_dev_stats(PyObject *obj, PyObject *) {
+    Engine *self = reinterpret_cast<Engine *>(obj);
+    return Py_BuildValue(
+        "{s:L,s:L,s:L}",
+        "dev_tx", (long long)self->dev_tx.load(std::memory_order_relaxed),
+        "dev_done",
+        (long long)self->dev_done.load(std::memory_order_relaxed),
+        "dev_bad", (long long)self->dev_bad.load(std::memory_order_relaxed));
+}
+
 // --------------------------------------------------- scheduler plane bind
 
 // sched_bind(plane_capsule) — attach the shared scheduler plane: classes
@@ -1390,9 +1522,10 @@ PyMethodDef engine_methods[] = {
     {"complete", engine_complete, METH_O,
      "complete(task_id) -> tuple of newly-ready per-task-lane ids"},
     {"register_class", engine_register_class, METH_VARARGS,
-     "register_class(callback, argmap, accs[, retire[, pool]]) -> "
-     "batch-lane class id; retire(n) fires after each batch's outputs "
-     "land; pool routes ready tasks through the bound scheduler plane"},
+     "register_class(callback, argmap, accs[, retire[, pool[, device]]]) "
+     "-> batch-lane class id; retire(n) fires after each batch's outputs "
+     "land; pool routes ready tasks through the bound scheduler plane; "
+     "device=1 surfaces ready tasks onto the ptdev lane once dev-bound"},
     {"insert_many", engine_insert_many, METH_O,
      "insert_many(specs) -> count; links the whole batch under one GIL "
      "drop (count-then-activate per task)"},
@@ -1453,6 +1586,15 @@ PyMethodDef engine_methods[] = {
      "PyCapsule(PtCommIngestVtbl) for Comm.register_pool (GIL-free ingest)"},
     {"comm_stats", engine_comm_stats, METH_NOARGS,
      "{acts_rx, ingest_bad, rsurf_pending}"},
+    {"dev_bind", engine_dev_bind, METH_VARARGS,
+     "dev_bind(submit_capsule, dev_pool): ready tasks of device-marked "
+     "classes surface onto the ptdev lane (bind before inserting them)"},
+    {"dev_retire_capsule", engine_dev_retire_capsule, METH_NOARGS,
+     "PyCapsule(PtDevRetireVtbl) for Lane.bind_pool (GIL-free retirement)"},
+    {"dev_retire", engine_dev_retire, METH_O,
+     "dev_retire(tid): one device task completed; run its release walk"},
+    {"dev_stats", engine_dev_stats, METH_NOARGS,
+     "{dev_tx, dev_done, dev_bad}"},
     {nullptr, nullptr, 0, nullptr}};
 
 // ----------------------------------------------------- insert fast path

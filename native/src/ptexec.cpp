@@ -54,6 +54,7 @@
 #include <vector>
 
 #include "ptcomm_iface.h"
+#include "ptdev_iface.h"
 #include "pthist.h"
 #include "ptrace_ring.h"
 #include "ptsched.h"
@@ -125,6 +126,24 @@ struct Graph {
     std::atomic<int64_t> acts_tx;       // remote releases surfaced
     std::atomic<int64_t> acts_rx;       // remote decrements ingested
     std::atomic<int64_t> ingest_bad;    // out-of-range ids from the wire
+    // device lane binding (dev_bind, ISSUE 10): tasks whose class carries
+    // a device body never enter the ready structure — the moment they
+    // become ready (release sweep, ingest, seeding) they surface onto the
+    // ptdev lane's MPSC pending queue through the submit vtable, still
+    // GIL-free (ptdev_iface.h). The lane's manager thread dispatches them
+    // asynchronously and lands completions back through dev_retire(),
+    // which runs the release walk exactly like a local CPU retire.
+    bool dev_bound;
+    uint32_t dev_pool;
+    PtDevSubmitVtbl dsend;
+    std::vector<uint8_t> *dev_mask;   // per task: 1 = device-bodied
+    std::vector<uint8_t> *dev_ret;    // per task: 1 = already retired (a
+                                      // duplicate/stale retire would
+                                      // double-run the release walk and
+                                      // underflow successor counters)
+    std::atomic<int64_t> dev_tx;      // tasks surfaced onto the lane
+    std::atomic<int64_t> dev_done;    // tasks retired by the lane
+    std::atomic<int64_t> dev_bad;     // out-of-range/unmasked retire ids
     // scheduler plane binding (sched_bind, ISSUE 9): when set, the ready
     // structure lives in the shared multi-pool plane (pool `spool`) — N
     // concurrent lane graphs then share the workers by DRR weight instead
@@ -177,7 +196,14 @@ bool slots_pending_locked(Graph *g, int32_t t) {
 // scheduler plane bound the item enters the plane instead (anonymous
 // producer: the callers here — ingest, rdv_land, seeding — have no worker
 // identity; the run() release sweep pushes batched with its worker id).
+// Device-bodied tasks take neither path: they surface straight onto the
+// ptdev lane (lock-free submit; mu-held is fine, it never blocks).
 void push_ready_locked(Graph *g, int32_t s) {
+    if (g->dev_bound && (*g->dev_mask)[(size_t)s]) {
+        g->dsend.submit(g->dsend.dev, g->dev_pool, s);
+        g->dev_tx.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
     if (g->comm_bound && slots_pending_locked(g, s)) {
         g->parked->push_back(s);
         return;
@@ -206,6 +232,34 @@ const int32_t *gather_prios(Graph *g, const std::vector<int32_t> &ids,
     return prios.data();
 }
 
+// mu held. Sweep device-bodied ids out of the private ready structure and
+// surface them onto the ptdev lane — the hand-off moment of dev_bind (and
+// of a reset on a bound graph): seeds landed in `ready` before the lane
+// existed. Returns the count surfaced.
+int64_t dev_sweep_ready_locked(Graph *g) {
+    if (!g->dev_bound || g->ready->empty()) return 0;
+    const uint8_t *dmask = g->dev_mask->data();
+    int64_t sent = 0;
+    size_t w = 0;
+    std::vector<int32_t> &rd = *g->ready;
+    for (size_t i = 0; i < rd.size(); i++) {
+        int32_t s = rd[i];
+        if (dmask[s]) {
+            g->dsend.submit(g->dsend.dev, g->dev_pool, s);
+            sent++;
+        } else {
+            rd[w++] = s;
+        }
+    }
+    rd.resize(w);
+    if (sent) {
+        g->dev_tx.fetch_add(sent, std::memory_order_relaxed);
+        if (g->use_heap)
+            std::make_heap(rd.begin(), rd.end(), PrioLess{g->prio->data()});
+    }
+    return sent;
+}
+
 // recompute the seed list: with owners bound, only LOCAL zero-goal tasks
 // may ever enter the ready structure (remote tasks run on their rank)
 void graph_rebuild_seeds(Graph *self) {
@@ -226,23 +280,28 @@ void graph_reset_state(Graph *self) {
                               std::memory_order_relaxed);
     if (self->splane) {
         // plane-resident ready structure: flush stale items of an
-        // abandoned run, then seed the pool afresh
+        // abandoned run, then seed the pool afresh (device-bodied seeds
+        // surface onto the ptdev lane, never the plane)
         self->splane->pool_clear(self->spool);
-        self->ready->clear();
-        if (!self->seeds->empty()) {
+        *self->ready = *self->seeds;
+        dev_sweep_ready_locked(self);
+        if (!self->ready->empty()) {
             std::vector<int32_t> prios;
-            self->splane->push(self->spool, -1, self->seeds->data(),
-                               gather_prios(self, *self->seeds, prios),
-                               (int)self->seeds->size());
+            self->splane->push(self->spool, -1, self->ready->data(),
+                               gather_prios(self, *self->ready, prios),
+                               (int)self->ready->size());
         }
+        self->ready->clear();
     } else {
         *self->ready = *self->seeds;
         if (self->use_heap)
             std::make_heap(self->ready->begin(), self->ready->end(),
                            PrioLess{self->prio->data()});
+        dev_sweep_ready_locked(self);   // device seeds surface to the lane
     }
     std::fill(self->rdv_pending->begin(), self->rdv_pending->end(),
               (uint8_t)0);
+    std::fill(self->dev_ret->begin(), self->dev_ret->end(), (uint8_t)0);
     self->parked->clear();
     for (int64_t j = 0; j < self->n_slots; j++)
         self->slot_cnt[j].store((*self->slot_uses)[(size_t)j],
@@ -295,13 +354,22 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     new (&self->acts_tx) std::atomic<int64_t>(0);
     new (&self->acts_rx) std::atomic<int64_t>(0);
     new (&self->ingest_bad) std::atomic<int64_t>(0);
+    self->dev_bound = false;
+    self->dev_pool = 0;
+    self->dsend = PtDevSubmitVtbl{0, nullptr, nullptr};
+    self->dev_mask = new (std::nothrow) std::vector<uint8_t>();
+    self->dev_ret = new (std::nothrow) std::vector<uint8_t>();
+    new (&self->dev_tx) std::atomic<int64_t>(0);
+    new (&self->dev_done) std::atomic<int64_t>(0);
+    new (&self->dev_bad) std::atomic<int64_t>(0);
     self->splane = nullptr;
     self->spool = -1;
     self->sched_cap = nullptr;
     if (!self->goals || !self->succ_off || !self->succs || !self->seeds ||
         !self->ready || !self->mu || !self->prio || !self->in_off ||
         !self->in_slots || !self->slot_uses || !self->retired ||
-        !self->owners || !self->rdv_pending || !self->parked) {
+        !self->owners || !self->rdv_pending || !self->parked ||
+        !self->dev_mask || !self->dev_ret) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -471,6 +539,8 @@ void graph_dealloc(PyObject *obj) {
     delete self->owners;
     delete self->rdv_pending;
     delete self->parked;
+    delete self->dev_mask;
+    delete self->dev_ret;
     delete[] self->counts;
     delete[] self->slot_cnt;
     delete[] self->ready_stamp;
@@ -692,7 +762,9 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
         freed.clear();
         const bool bound = self->comm_bound;
         const int32_t *own = bound ? self->owners->data() : nullptr;
-        int64_t sent = 0;
+        const bool devb = self->dev_bound;
+        const uint8_t *dmask = devb ? self->dev_mask->data() : nullptr;
+        int64_t sent = 0, dsent = 0;
         for (int32_t t : local) {
             if (tr) tw.rec(EV_TASK, t, ptrace_ring::FLAG_START);
             for (int32_t k = off[t]; k < off[t + 1]; k++) {
@@ -708,8 +780,18 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                     continue;
                 }
                 if (self->counts[s].fetch_sub(
-                        1, std::memory_order_acq_rel) == 1)
-                    fresh.push_back(s);
+                        1, std::memory_order_acq_rel) == 1) {
+                    if (devb && dmask[s]) {
+                        // device-bodied successor: surfaces onto the
+                        // ptdev lane's pending queue instead of the
+                        // ready structure — still GIL-free, never blocks
+                        self->dsend.submit(self->dsend.dev, self->dev_pool,
+                                           s);
+                        dsent++;
+                    } else {
+                        fresh.push_back(s);
+                    }
+                }
             }
             if (data_mode) {
                 // the datarepo retire protocol: this task's bodies have
@@ -726,6 +808,8 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
         }
         if (sent)
             self->acts_tx.fetch_add(sent, std::memory_order_relaxed);
+        if (dsent)
+            self->dev_tx.fetch_add(dsent, std::memory_order_relaxed);
         if (hs && !fresh.empty()) {
             // stamp sampled newly-ready ids before they enter the ready
             // structure (one clock read per release batch; plain stores)
@@ -953,6 +1037,181 @@ PyObject *graph_comm_bind(PyObject *obj, PyObject *args) {
     graph_rebuild_seeds(self);
     graph_reset_state(self);
     return Py_BuildValue("L", (long long)self->n_local);
+}
+
+// ------------------------------------------------------- device lane bind
+
+// The GIL-free retire entry the ptdev manager thread calls through the
+// PtDevRetireVtbl capsule once a dispatched task's completion events
+// fired (its outputs already landed in the Python-owned slots): run the
+// release walk — successor decrements (more device tasks surface back
+// onto the lane; CPU successors enter the ready structure/plane), slot
+// retires, completion accounting — exactly the run() sweep, per task.
+void graph_dev_retire_c(void *obj, int32_t t) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    if (t < 0 || (int64_t)t >= self->n || !self->dev_bound ||
+        !(*self->dev_mask)[(size_t)t]) {
+        // ids the lane was never handed are as untrusted as wire ids
+        self->dev_bad.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    {
+        // duplicate/stale retires (a buggy poll closure, a retire racing
+        // a reset) must not double-run the release walk — successor
+        // counters would underflow and fire twice or wrap dead
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if ((*self->dev_ret)[(size_t)t]) {
+            self->dev_bad.fetch_add(1, std::memory_order_relaxed);
+            return;
+        }
+        (*self->dev_ret)[(size_t)t] = 1;
+    }
+    const int32_t *off = self->succ_off->data();
+    const int32_t *succ = self->succs->data();
+    const bool data_mode = !self->in_off->empty();
+    const bool bound = self->comm_bound;
+    const int32_t *own = bound ? self->owners->data() : nullptr;
+    std::vector<int32_t> fresh, freed;
+    for (int32_t k = off[t]; k < off[t + 1]; k++) {
+        int32_t s = succ[k];
+        if (bound && own[s] != self->my_rank) {
+            self->send.send_act(self->send.comm, own[s], self->pool_id, s);
+            self->acts_tx.fetch_add(1, std::memory_order_relaxed);
+            continue;
+        }
+        if (self->counts[s].fetch_sub(1, std::memory_order_acq_rel) == 1)
+            fresh.push_back(s);
+    }
+    if (data_mode) {
+        const int32_t *ioff = self->in_off->data();
+        const int32_t *islot = self->in_slots->data();
+        for (int32_t k = ioff[t]; k < ioff[t + 1]; k++) {
+            int32_t j = islot[k];
+            if (self->slot_cnt[j].fetch_sub(
+                    1, std::memory_order_acq_rel) == 1)
+                freed.push_back(j);
+        }
+    }
+    pthist::State<N_HISTS> *hs = self->hist.load(std::memory_order_acquire);
+    if (hs && hs->enabled.load(std::memory_order_relaxed) &&
+        !fresh.empty()) {
+        int64_t now = ptrace_ring::now_ns();
+        for (int32_t s : fresh)
+            if (hist_sampled(s))
+                self->ready_stamp[s].store(now, std::memory_order_relaxed);
+    }
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        self->completed++;
+        // push_ready_locked routes each successor: device-bodied back to
+        // the lane, plane-bound to the plane, the rest to the vector
+        for (int32_t s : fresh) push_ready_locked(self, s);
+        if (!freed.empty()) {
+            self->retired->insert(self->retired->end(), freed.begin(),
+                                  freed.end());
+            self->nb_slots_retired += (int64_t)freed.size();
+        }
+    }
+    self->dev_done.fetch_add(1, std::memory_order_relaxed);
+    ptrace_ring::Writer tw;
+    tw.open(self->trace.load(std::memory_order_acquire));
+    if (tw.st) {
+        // the device task's retire step as a (tiny) EV_TASK interval so
+        // merged traces pair every lane task exactly like CPU retires
+        tw.rec(EV_TASK, t, ptrace_ring::FLAG_START);
+        tw.rec(EV_TASK, t, ptrace_ring::FLAG_END);
+    }
+}
+
+void dev_retire_capsule_free(PyObject *cap) {
+    std::free(PyCapsule_GetPointer(cap, PTDEV_RETIRE_CAPSULE));
+}
+
+// dev_retire_capsule() -> PyCapsule(PtDevRetireVtbl) for Lane.bind_pool.
+// The capsule borrows `self`: the device lane holds a strong ref to the
+// graph for the bind window (ptdev_iface.h lifetime rules).
+PyObject *graph_dev_retire_capsule(PyObject *obj, PyObject *) {
+    PtDevRetireVtbl *v =
+        static_cast<PtDevRetireVtbl *>(std::malloc(sizeof(PtDevRetireVtbl)));
+    if (!v) return PyErr_NoMemory();
+    v->abi = PTDEV_ABI;
+    v->obj = obj;
+    v->retire = graph_dev_retire_c;
+    PyObject *cap = PyCapsule_New(v, PTDEV_RETIRE_CAPSULE,
+                                  dev_retire_capsule_free);
+    if (!cap) std::free(v);
+    return cap;
+}
+
+// dev_bind(submit_capsule, dev_pool, mask) -> n_seeded — enter device
+// mode: `mask[i]` flags task i as device-bodied. Ready device tasks
+// already seeded into the private structure surface onto the lane NOW
+// (the hand-off of dev_sweep_ready_locked); everything after routes at
+// the release sites. Bind BEFORE the context enqueues the graph (and
+// before any sched_bind) so no device id ever reaches the plane.
+PyObject *graph_dev_bind(PyObject *obj, PyObject *args) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    PyObject *cap, *mask_o;
+    unsigned int pool;
+    if (!PyArg_ParseTuple(args, "OIO", &cap, &pool, &mask_o))
+        return nullptr;
+    PtDevSubmitVtbl *sv = static_cast<PtDevSubmitVtbl *>(
+        PyCapsule_GetPointer(cap, PTDEV_SUBMIT_CAPSULE));
+    if (!sv) return nullptr;
+    if (sv->abi != PTDEV_ABI) {
+        PyErr_SetString(PyExc_RuntimeError, "ptdev ABI mismatch");
+        return nullptr;
+    }
+    std::vector<int32_t> mask32;
+    if (!parse_i32_list(mask_o, mask32, "mask: sequence of ints"))
+        return nullptr;
+    if ((int64_t)mask32.size() != self->n) {
+        PyErr_SetString(PyExc_ValueError, "mask must have n entries");
+        return nullptr;
+    }
+    int64_t seeded;
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        if (self->dev_bound) {
+            PyErr_SetString(PyExc_RuntimeError, "graph already dev-bound");
+            return nullptr;
+        }
+        if (self->running > 0 || self->completed > 0) {
+            PyErr_SetString(PyExc_RuntimeError,
+                            "dev_bind() on a graph already running");
+            return nullptr;
+        }
+        self->dev_mask->resize((size_t)self->n);
+        self->dev_ret->assign((size_t)self->n, 0);
+        for (int64_t i = 0; i < self->n; i++)
+            (*self->dev_mask)[(size_t)i] = mask32[(size_t)i] ? 1 : 0;
+        self->dsend = *sv;
+        self->dev_pool = pool;
+        self->dev_bound = true;
+        seeded = dev_sweep_ready_locked(self);
+    }
+    return PyLong_FromLongLong(seeded);
+}
+
+// Python mirror of the C retire entry (tests + non-native drivers)
+PyObject *graph_dev_retire(PyObject *obj, PyObject *arg) {
+    long tid = PyLong_AsLong(arg);
+    if (tid == -1 && PyErr_Occurred()) return nullptr;
+    graph_dev_retire_c(obj, (int32_t)tid);
+    Py_RETURN_NONE;
+}
+
+PyObject *graph_dev_stats(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    int64_t ndev = 0;
+    for (uint8_t m : *self->dev_mask) ndev += m;
+    return Py_BuildValue(
+        "{s:L,s:L,s:L,s:L}",
+        "dev_tx", (long long)self->dev_tx.load(std::memory_order_relaxed),
+        "dev_done",
+        (long long)self->dev_done.load(std::memory_order_relaxed),
+        "dev_bad", (long long)self->dev_bad.load(std::memory_order_relaxed),
+        "n_dev", (long long)ndev);
 }
 
 // --------------------------------------------------- scheduler plane bind
@@ -1209,6 +1468,15 @@ PyMethodDef graph_methods[] = {
      "rdv_land(slot): pull landed; release parked consumers"},
     {"comm_stats", graph_comm_stats, METH_NOARGS,
      "{acts_tx, acts_rx, ingest_bad, n_local, parked}"},
+    {"dev_bind", graph_dev_bind, METH_VARARGS,
+     "dev_bind(submit_capsule, dev_pool, mask) -> n_seeded: enter device "
+     "mode (masked tasks surface onto the ptdev lane when ready)"},
+    {"dev_retire_capsule", graph_dev_retire_capsule, METH_NOARGS,
+     "PyCapsule(PtDevRetireVtbl) for Lane.bind_pool (GIL-free retirement)"},
+    {"dev_retire", graph_dev_retire, METH_O,
+     "dev_retire(tid): one device task completed; run its release walk"},
+    {"dev_stats", graph_dev_stats, METH_NOARGS,
+     "{dev_tx, dev_done, dev_bad, n_dev}"},
     {"trace_enable", graph_trace_enable, METH_VARARGS,
      "trace_enable(nrings=16, capacity=65536) -> (nrings, cap): arm the "
      "in-lane event rings (idempotent; see ptrace_ring.h)"},

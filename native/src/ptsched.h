@@ -190,24 +190,40 @@ struct Plane {
         std::lock_guard<std::mutex> rl(reg_mu);
         for (int i = 0; i < MAX_POOLS; i++) {
             Pool &p = pools[i];
-            std::lock_guard<std::mutex> pl(p.mu);
-            if (p.live) continue;
-            p.overflow.clear();
-            p.heap = (policy == POLICY_PRIO);
-            p.kind = kind;
-            p.weight = weight > 0 ? weight : 1;
-            p.window = window > 0 ? window : 0;
-            p.ext_id = ext_id;
-            p.queued.store(0, std::memory_order_relaxed);
-            p.inflight.store(0, std::memory_order_relaxed);
-            p.served.store(0, std::memory_order_relaxed);
-            p.spills.store(0, std::memory_order_relaxed);
-            p.stalls.store(0, std::memory_order_relaxed);
+            bool claimed = false;
             {
+                std::lock_guard<std::mutex> pl(p.mu);
+                if (!p.live) {
+                    p.overflow.clear();
+                    p.heap = (policy == POLICY_PRIO);
+                    p.kind = kind;
+                    p.weight = weight > 0 ? weight : 1;
+                    p.window = window > 0 ? window : 0;
+                    p.ext_id = ext_id;
+                    p.queued.store(0, std::memory_order_relaxed);
+                    p.inflight.store(0, std::memory_order_relaxed);
+                    p.served.store(0, std::memory_order_relaxed);
+                    p.spills.store(0, std::memory_order_relaxed);
+                    p.stalls.store(0, std::memory_order_relaxed);
+                    p.live = true;
+                    claimed = true;
+                }
+            }
+            if (!claimed) continue;
+            {
+                // deficit reset AFTER p.mu drops: the arbitration lock
+                // nests INSIDE p.mu's scope here while refill_drr holds
+                // arb_mu across take_overflow's p.mu — taking them in
+                // both orders was an ABBA deadlock a register racing a
+                // mixed-kind pop could hit (found by the churn test
+                // wedging the full suite under load; whichever thread
+                // deadlocked held the GIL, freezing the process). A pop
+                // reading the pre-reset deficit in the window costs one
+                // WDRR credit blip on a just-registered pool, nothing
+                // more — deficit is advisory fairness state.
                 std::lock_guard<std::mutex> al(arb_mu);
                 p.deficit = 0;
             }
-            p.live = true;
             pools_registered.fetch_add(1, std::memory_order_relaxed);
             pools_live.fetch_add(1, std::memory_order_relaxed);
             return i;

@@ -211,6 +211,17 @@ class Context:
         #: walk is GIL-free, so in-process workers scale on real cores)
         self._ptexec_q: List = []
         self._ptexec_lock = threading.Lock()
+        #: the native DEVICE lane (device/native.py, ISSUE 10): one per
+        #: context, created lazily the first time a TPU-bodied pool
+        #: prepares for the execution lane (None = not yet tried, False =
+        #: tried and unavailable). Its manager thread feeds completions
+        #: back into the graphs GIL-free; fini tears it down BEFORE the
+        #: device modules.
+        self._ptdev: Any = None
+        #: count of DEVICE-BOUND lane graphs in flight — same backoff
+        #: treatment as comm-bound graphs: the next ready task comes from
+        #: the lane's manager thread, not from this process's walk
+        self._ptexec_dev_live = 0
         #: count of COMM-BOUND lane graphs in flight: while one lives,
         #: starvation backoff is capped near the wire latency — the comm
         #: progress thread ingests remote releases GIL-free at any
@@ -464,6 +475,11 @@ class Context:
         self._work_event.set()
         for t in self._workers:
             t.join(timeout=5.0)
+        if self._ptdev:
+            # device lane down BEFORE the device modules: its manager
+            # thread dispatches through them under the GIL
+            self._ptdev.fini()
+            self._ptdev = False
         self.devices.fini()
         if self.comm is not None:
             self.comm.fini()
@@ -529,6 +545,20 @@ class Context:
         # threads (user code, comm thread) act as the master stream
         return getattr(self._tls, "stream", None) or self.streams[0]
 
+    # ------------------------------------------------------------ device lane
+    def _ptdev_lane(self):
+        """The context's native device lane (device/native.py), created
+        lazily on the first TPU-bodied lane pool, or None when it cannot
+        engage (no accelerator device, --mca device_native 0, module
+        missing). The verdict is memoized — probing it per pool would
+        retry a failed module load on every instantiation."""
+        if self._ptdev is not None:
+            return self._ptdev or None
+        from ..device.native import NativeDeviceLane
+        lane = NativeDeviceLane.maybe_create(self)
+        self._ptdev = lane if lane is not None else False
+        return lane
+
     # ------------------------------------------------------------ native lane
     def _ptexec_enqueue(self, tp: Taskpool, lane: Dict[str, Any]) -> None:
         """A PTG taskpool handed its whole FSM to the native execution
@@ -538,10 +568,17 @@ class Context:
         # burst so no lane event predates its rings
         self._ntrace_attach("ptexec", lane["graph"], tp.taskpool_id)
         self._hist_attach("ptexec", lane["graph"])
+        if lane.get("dev_pool") is not None:
+            # the device lane outlives pools; re-attach per enqueue
+            # (idempotent) so a tracer attached AFTER the lane's creation
+            # still lands this pool's EV_DEV_* events
+            self._ntrace_attach("ptdev", lane["dev"].clane)
         with self._ptexec_lock:
             self._ptexec_q.append((tp, lane))
             if lane.get("pool_id") is not None:
                 self._ptexec_comm_live += 1
+            if lane.get("dev_pool") is not None:
+                self._ptexec_dev_live += 1
             # scheduler plane, LAZY arming (the one-pool fast path): a
             # lone lane graph keeps its private allocation-free ready
             # vector — zero plane crossings on the 10M/s chain walk. The
@@ -636,15 +673,26 @@ class Context:
             # credits, then returns to the arbiter for the next pick
             budget = max(256, min(budget, quantum))
         try:
+            dv = lane.get("dev")
+            if dv is not None:
+                msg = dv.failed()
+                if msg is not None:
+                    # a device dispatch/poll callback raised on the lane's
+                    # manager thread (which has no caller to propagate
+                    # to): surface it here as the pool's error
+                    raise RuntimeError(
+                        f"native device lane callback failed: {msg}")
             mine = graph.run(lane["callback"], 256, budget, stream.th_id)
-            if mine == 0 and lane.get("pool_id") is not None \
+            if mine == 0 and (lane.get("pool_id") is not None
+                              or lane.get("dev_pool") is not None) \
                     and not graph.failed() and not graph.done():
-                # comm-bound lane starved mid-graph: the next ready task
-                # arrives from the comm progress thread (GIL-free), not
-                # from this process — micro-poll briefly instead of
-                # paying a full hot-loop iteration per cross-rank hop
-                # (bounded: ~1ms, then the outer loop resumes its usual
-                # error/deadline/device servicing)
+                # comm- or device-bound lane starved mid-graph: the next
+                # ready task arrives from the comm progress thread or the
+                # device manager thread (both GIL-free/GIL-taking off
+                # this loop), not from this process's walk — micro-poll
+                # briefly instead of paying a full hot-loop iteration per
+                # hop (bounded: ~1ms, then the outer loop resumes its
+                # usual error/deadline/device servicing)
                 for spin in range(224):
                     # yield-spin first (the GIL is free: the comm thread
                     # runs without it), then ease into short naps
@@ -698,6 +746,8 @@ class Context:
                 self._ptexec_q.pop(i)
                 if lane.get("pool_id") is not None:
                     self._ptexec_comm_live -= 1
+                if lane.get("dev_pool") is not None:
+                    self._ptexec_dev_live -= 1
                 return
 
     def _sched_pool_retire(self, lane: Dict[str, Any]) -> None:
@@ -761,6 +811,10 @@ class Context:
         self._ntrace_detach(lane["graph"])   # final drain of an errored lane
         self._hist_detach(lane["graph"])
         self._sched_pool_retire(lane)        # free the plane pool slot
+        if lane.get("dev_pool") is not None:
+            # stop routing the poisoned pool's device completions (in-
+            # flight retires for it count late_retires, never land)
+            lane["dev"].unbind_pool(lane.pop("dev_pool"))
         slots = lane.get("slots")
         if not slots:
             return
@@ -957,7 +1011,8 @@ class Context:
                 # graph is in flight: its next ready task arrives from
                 # the comm progress thread, not from this process, and a
                 # ms-scale sleep would dominate every cross-rank hop
-                cap = 2e-5 if self._ptexec_comm_live else backoff_max
+                cap = 2e-5 if (self._ptexec_comm_live
+                               or self._ptexec_dev_live) else backoff_max
                 if cap == backoff_max and self.sched_plane is not None \
                         and (self._ptexec_q or self._dtd_batch_pools) \
                         and self.sched_plane.queued_total() > 0:

@@ -156,6 +156,60 @@ class Data:
                     copy.coherency_state = COHERENCY_SHARED
             return copy
 
+    def evict_copy(self, device_index: int, to_host=None):
+        """Evict the copy on ``device_index`` atomically with the
+        coherency/version bookkeeping (the zone-heap eviction gap, ISSUE
+        10): under ONE hold of the data lock, a copy holding the newest
+        version writes back to the host copy (which takes the version in
+        SHARED state — the w2r moment of transfer_gpu.c) and only then
+        drops its payload and goes INVALID. Before this, the device
+        module's LRU and this class were two unsynchronized views: a
+        reader racing the eviction could see the device copy still
+        claiming the newest version with its payload already dropped (or
+        the host copy not yet carrying it), and a concurrent host write
+        between the version check and the write-back could be clobbered
+        by the stale device payload.
+
+        ``to_host(payload)`` converts the device array for the host copy
+        (default ``numpy.asarray`` — blocks until the device value is
+        ready, which is exactly the write-back barrier).
+
+        Returns ``(evicted, wrote_back)``.
+        """
+        import numpy as _np
+        with self._lock:
+            copy = self.copies.get(device_index)
+            if copy is None or copy.payload is None:
+                return (False, False)
+            wrote = False
+            newest_other = None
+            for c in self.copies.values():
+                if c is copy or c.coherency_state == COHERENCY_INVALID:
+                    continue
+                if newest_other is None or c.version > newest_other.version:
+                    newest_other = c
+            if device_index != 0 and \
+                    copy.coherency_state != COHERENCY_INVALID and (
+                    newest_other is None
+                    or copy.version > newest_other.version):
+                # dirty: the only valid holder of the newest version —
+                # write back and downgrade BEFORE invalidating, inside
+                # the same critical section as the version check
+                host_payload = (to_host or _np.asarray)(copy.payload)
+                host = self.copies.get(0)
+                if host is None:
+                    host = DataCopy(self, 0, host_payload, COHERENCY_SHARED)
+                    self.copies[0] = host
+                else:
+                    host.payload = host_payload
+                host.version = copy.version
+                host.coherency_state = COHERENCY_SHARED
+                self.owner_device = 0
+                wrote = True
+            copy.coherency_state = COHERENCY_INVALID
+            copy.payload = None
+            return (True, wrote)
+
     def bump_version(self, device_index: int, n: int = 1) -> int:
         """Writer completed: new authoritative version on that device
         (ref: version bump in parsec_device_kernel_epilog, device_gpu.c:3180).

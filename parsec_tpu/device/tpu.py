@@ -129,6 +129,111 @@ class TPUDevice(DeviceModule):
         # eviction accounting even for small tiles (a 1MB default unit would
         # fill the zone ~100x faster than _resident_bytes and desync them)
         self._zone = ZoneMalloc(self._budget, unit=65536)
+        # the NATIVE coherency/residency table (ISSUE 10): when _ptdev is
+        # available, C owns residency and eviction POLICY — the LRU order,
+        # the byte budget, the stage-in version check, victim selection —
+        # while Python keeps owning the payloads, the write-back mechanism
+        # and the `_lru`/`_zone` mirror the tests inspect. One authority
+        # instead of the two unsynchronized views (this LRU vs data.py
+        # coherency) that the eviction/reader race grew from.
+        from .native import make_coh_table
+        self._ncoh = make_coh_table(self._budget)
+        # serializes the Python residency MIRROR (_lru/_lru_sizes/_zone/
+        # _resident_bytes): the interpreted path mutates it from worker
+        # threads (under _manager_lock) while the ptdev manager thread
+        # mutates it from lane stage-ins — compound updates like the
+        # resident-bytes delta are not GIL-atomic across both
+        self._heap_lock = threading.RLock()
+
+    # ------------------------------------------------- native coherency map
+    @staticmethod
+    def res_key(data: Data) -> int:
+        """The canonical residency key for BOTH the Python LRU mirror and
+        the C coherency table. ``data.key`` is only unique per collection
+        (A(0,0)/B(0,0)/C(0,0) all carry key 0 — the aliasing the table
+        exposed), so the Data object's identity is the key: a resident
+        entry's DataCopy pins its Data, so the id cannot be reused while
+        the entry lives; a dead Data's stale table entry can only cause a
+        spurious re-stage (version mismatch), never a wrong hit on a live
+        payload."""
+        return id(data)
+
+    def _coh_pin(self, data: Data) -> None:
+        if self._ncoh is not None and data is not None:
+            self._ncoh.pin(self.res_key(data))
+
+    def _coh_unpin(self, data: Data) -> None:
+        if self._ncoh is not None and data is not None:
+            self._ncoh.unpin(self.res_key(data))
+
+    def _coh_mark_owned(self, data: Data, copy: DataCopy) -> None:
+        """Writer completed on this device: the table's entry becomes the
+        OWNER at the new version (the epilog bump); growth past the
+        budget returns eviction victims to apply."""
+        if self._ncoh is None:
+            return
+        victims = self._ncoh.mark_owned(self.res_key(data),
+                                        data.version & 0xFFFFFFFF,
+                                        _nbytes(copy.payload))
+        if victims:
+            self._apply_victims(victims)
+
+    def _apply_victims(self, victims) -> None:
+        """Commit the C table's eviction decisions: write back +
+        invalidate each victim ATOMICALLY with its version check
+        (Data.evict_copy), then update the Python mirror (sizes, zone
+        ledger, counters). Policy came from C; this is pure mechanism."""
+        with self._heap_lock:
+            self._apply_victims_locked(victims)
+
+    def _apply_victims_locked(self, victims) -> None:
+        for key, _owned in victims:
+            copy = self._lru.get(key)
+            if copy is None:
+                continue
+            if copy.readers > 0:
+                # a Python-side pin the table could not see (a custom
+                # stage hook pins only after its stage-in returns): veto
+                # the eviction — the table already dropped its entry, so
+                # the next stage-in simply re-reserves, and the inflight
+                # reader keeps its payload
+                self.pinned_skips += 1
+                continue
+            self._lru.pop(key)
+            self._evict_key_locked(key, copy, drop_table=False)
+
+    def _evict_key_locked(self, key: Any, copy: DataCopy,
+                          drop_table: bool) -> None:
+        """The ONE eviction mechanism (heap lock held, `key` already out
+        of ``_lru``): mirror bookkeeping, the atomic write-back +
+        invalidate (Data.evict_copy), and the counters. ``drop_table``
+        removes the C entry too (the Python-LRU fallback path decided the
+        victim itself; C-decided victims already left the table)."""
+        freed = self._lru_sizes.pop(key, 0)
+        self._resident_bytes -= freed
+        seg = self._lru_segs.pop(key, None)
+        if seg is not None:
+            seg.free()
+        data = copy.original
+        wrote = False
+        if data is not None:
+            _evicted, wrote = data.evict_copy(self.device_index)
+        else:
+            copy.coherency_state = COHERENCY_INVALID
+            copy.payload = None
+        if wrote:
+            self.transfer_out_bytes += freed
+            if self._ncoh is not None:
+                self._ncoh.count_writeback(freed)
+        if drop_table and self._ncoh is not None:
+            self._ncoh.drop(key)
+        self.evictions += 1
+        self._trace_mem(-freed)
+
+    def coh_stats(self) -> Optional[Dict[str, int]]:
+        """The native coherency/residency counters, or None when the
+        table is unavailable (Python-LRU fallback mode)."""
+        return None if self._ncoh is None else self._ncoh.stats()
 
     # ------------------------------------------------------------- dispatch API
     def kernel_scheduler(self, stream, task: Task, tpu_task: Optional[TPUTask] = None,
@@ -213,23 +318,54 @@ class TPUDevice(DeviceModule):
             self._manager_lock.release()
 
     # ------------------------------------------------------------- internals
-    def _stage_in_copy(self, data: Data, access: int) -> DataCopy:
+    def _stage_in_copy(self, data: Data, access: int,
+                       pin: bool = False) -> DataCopy:
         """Version-checked stage-in (ref: parsec_device_data_stage_in
-        device_gpu.c:1800). Returns the device-resident copy."""
+        device_gpu.c:1800). Returns the device-resident copy.
+
+        With the native table up, the residency decision — is a copy of
+        exactly this version resident, and which victims must leave to
+        make room — is C's (CohTable.stage_in issues the early reserve of
+        the push stage); this method stays the transfer MECHANISM.
+        ``pin=True`` takes the eviction pin INSIDE the table's reserve
+        critical section (a concurrent stage-in on another thread could
+        otherwise evict this entry between the reserve and the caller's
+        pin) and bumps the Python reader count to match — release with
+        :meth:`unpin_copy`."""
         dev_idx = self.device_index
         copy = data.get_copy(dev_idx)
         newest = data.newest_copy()
-        if copy is not None and newest is not None and \
+        if self._ncoh is not None and newest is not None:
+            nbytes = _nbytes(newest.payload)
+            need, victims = self._ncoh.stage_in(
+                self.res_key(data), nbytes,
+                newest.version & 0xFFFFFFFF, 0, 1 if pin else 0)
+            if victims:
+                self._apply_victims(victims)
+            if not need and copy is not None and \
+                    copy.version == newest.version and \
+                    copy.coherency_state != COHERENCY_INVALID:
+                self._lru_touch(self.res_key(data), copy)
+                if pin:
+                    with self._heap_lock:
+                        copy.readers += 1     # table half pinned above
+                return copy
+            # table said transfer (or the mirror lost the payload: the
+            # stale table entry was already replaced by stage_in)
+        elif copy is not None and newest is not None and \
                 copy.version == newest.version and \
                 copy.coherency_state != COHERENCY_INVALID:
-            self._lru_touch(data.key, copy)
+            self._lru_touch(self.res_key(data), copy)
+            if pin:
+                self.pin_copy(copy)
             return copy
         src = newest
         if src is None:
             raise RuntimeError(f"no valid copy to stage in for {data!r}")
         arr = self._jax.device_put(src.payload, self.jax_device)  # async H2D/D2D
         nbytes = _nbytes(arr)
-        self._reserve(nbytes)
+        if self._ncoh is None:
+            self._reserve(nbytes)   # native mode: stage_in reserved above
         if copy is None:
             copy = data.create_copy(dev_idx, arr, COHERENCY_SHARED)
         else:
@@ -237,8 +373,21 @@ class TPUDevice(DeviceModule):
             copy.coherency_state = COHERENCY_SHARED
         copy.version = src.version
         self.transfer_in_bytes += nbytes
-        self._lru_touch(data.key, copy)
+        self._lru_touch(self.res_key(data), copy)
+        if pin:
+            if self._ncoh is not None:
+                with self._heap_lock:
+                    copy.readers += 1     # table half pinned in stage_in
+            else:
+                self.pin_copy(copy)
         return copy
+
+    def lane_stage_in(self, data: Data, pin: bool = False) -> DataCopy:
+        """Stage-in entry for the native device lane's dispatch callback
+        (the push phase of ptdev): version-checked through the C table,
+        asynchronous, returns the device copy — pinned atomically with
+        the reserve when ``pin``."""
+        return self._stage_in_copy(data, 0, pin=pin)
 
     def _prof(self):
         """Per-device profiling stream (ref: per-GPU-stream profiling
@@ -302,12 +451,18 @@ class TPUDevice(DeviceModule):
             # they bypass the LRU heap and just get placed on-device
             data = getattr(copy_in, "original", None)
             if data is not None:
-                dev_copy = (gt.stage_in or self._default_stage_in)(data, flow.access)
-                slot.data_in = dev_copy
                 # pin between stage-in and epilog: the eviction walks skip
                 # copies with readers > 0, so an inflight task's inputs
-                # can never be evicted under it (device_gpu.c:1210)
-                dev_copy.readers += 1
+                # can never be evicted under it (device_gpu.c:1210). The
+                # default path pins INSIDE the table's reserve critical
+                # section; custom stage hooks pin right after
+                if gt.stage_in is None:
+                    dev_copy = self._stage_in_copy(data, flow.access,
+                                                   pin=True)
+                else:
+                    dev_copy = gt.stage_in(data, flow.access)
+                    self.pin_copy(dev_copy)
+                slot.data_in = dev_copy
                 gt.pinned.append(dev_copy)
                 inputs.append(dev_copy.payload)
             else:
@@ -318,7 +473,7 @@ class TPUDevice(DeviceModule):
     def _unpin(self, gt: TPUTask) -> None:
         """Drop this task's reader pins (epilog or failed submit)."""
         for copy in gt.pinned:
-            copy.readers -= 1
+            self.unpin_copy(copy)
         gt.pinned.clear()
 
     def _submit_one_retry(self, gt: TPUTask) -> bool:
@@ -401,7 +556,8 @@ class TPUDevice(DeviceModule):
                     copy.payload = arr
                 data.bump_version(self.device_index)
                 slot.data_out = copy
-                self._lru_touch(data.key, copy)
+                self._lru_touch(self.res_key(data), copy)
+                self._coh_mark_owned(data, copy)
                 if gt.pushout & (1 << flow.flow_index):
                     self._stage_out(data, copy)
             else:
@@ -435,6 +591,10 @@ class TPUDevice(DeviceModule):
         # account by the size actually resident under this key: an epilog may
         # rebind the copy's payload to a different-sized array, and the budget
         # must follow (the eviction math drifts otherwise)
+        with self._heap_lock:
+            self._lru_touch_locked(key, copy)
+
+    def _lru_touch_locked(self, key: Any, copy: DataCopy) -> None:
         self._lru.pop(key, None)
         new_size = _nbytes(copy.payload)
         old_size = self._lru_sizes.get(key, 0)
@@ -454,39 +614,56 @@ class TPUDevice(DeviceModule):
                 self._lru_segs[key] = seg
 
     def _evict_one(self) -> bool:
-        """Evict the least-recently-used unpinned copy (dirty copies are
-        written back first). Returns False when everything is pinned."""
+        """Evict the least-recently-used unpinned copy; an OWNED copy
+        writes back AND downgrades atomically with the version check
+        (Data.evict_copy — one critical section, so a reader racing the
+        eviction can never see the newest version without a valid
+        payload). Python-LRU fallback path; with the native table up,
+        victim selection comes from C (:meth:`_apply_victims`)."""
+        with self._heap_lock:
+            return self._evict_one_locked()
+
+    def _evict_one_locked(self) -> bool:
         for key in list(self._lru):
             copy = self._lru[key]
             if copy.readers > 0:
                 self.pinned_skips += 1
                 continue
-            data = copy.original
-            if data is not None and copy.coherency_state == COHERENCY_OWNED \
-                    and data.newest_copy() is copy:
-                self._stage_out(data, copy)   # dirty: write back first
             self._lru.pop(key)
-            freed = self._lru_sizes.pop(key, 0)
-            self._resident_bytes -= freed
-            seg = self._lru_segs.pop(key, None)
-            if seg is not None:
-                seg.free()
-            copy.coherency_state = COHERENCY_INVALID
-            copy.payload = None
-            self.evictions += 1
-            self._trace_mem(-freed)
+            self._evict_key_locked(key, copy, drop_table=True)
             return True
         return False
 
     def evict_bytes(self, nbytes: int) -> int:
         """Force eviction of about ``nbytes`` of resident clean/dirty copies
-        (the explicit half of the OOM retry path)."""
-        target = max(0, self._resident_bytes - nbytes)
+        (the explicit half of the OOM retry path). With the native table
+        up, the victim set is C's decision."""
         freed0 = self._resident_bytes
+        if self._ncoh is not None:
+            victims, skips = self._ncoh.evict(nbytes)
+            self._apply_victims(victims)
+            self.pinned_skips += skips
+            return freed0 - self._resident_bytes
+        target = max(0, self._resident_bytes - nbytes)
         while self._resident_bytes > target and self._lru:
             if not self._evict_one():
                 break
         return freed0 - self._resident_bytes
+
+    def pin_copy(self, copy: DataCopy) -> None:
+        """Pin a device copy against eviction (the inflight-task reader
+        guard): bumps the Python reader count AND the native table's pin
+        so C's victim selection honors it. The reader count mutates from
+        interpreted-path workers AND the ptdev manager thread — the
+        non-atomic ``+=`` goes under the heap lock so no update is lost."""
+        with self._heap_lock:
+            copy.readers += 1
+        self._coh_pin(copy.original)
+
+    def unpin_copy(self, copy: DataCopy) -> None:
+        with self._heap_lock:
+            copy.readers -= 1
+        self._coh_unpin(copy.original)
 
     def _reserve(self, nbytes: int) -> None:
         """Evict LRU copies until ``nbytes`` fits the budget
@@ -504,13 +681,17 @@ class TPUDevice(DeviceModule):
         """Resize the HBM tile budget (tests / MCA reconfiguration): the
         zone ledger is rebuilt and current residents re-registered."""
         from ..utils.zone_malloc import ZoneMalloc
-        self._budget = nbytes
-        self._zone = ZoneMalloc(nbytes, unit)
-        self._lru_segs = {}
-        for key, sz in self._lru_sizes.items():
-            seg = self._zone.allocate(sz)
-            if seg is not None:
-                self._lru_segs[key] = seg
+        with self._heap_lock:
+            self._budget = nbytes
+            if self._ncoh is not None:
+                # C applies the new budget first (victims leave both views)
+                self._apply_victims_locked(self._ncoh.set_budget(nbytes))
+            self._zone = ZoneMalloc(nbytes, unit)
+            self._lru_segs = {}
+            for key, sz in self._lru_sizes.items():
+                seg = self._zone.allocate(sz)
+                if seg is not None:
+                    self._lru_segs[key] = seg
 
     def fini(self) -> None:
         self._lru.clear()

@@ -45,6 +45,23 @@ from ..utils import mca, output
 mca.register("capture_scan_threshold", 64,
              help="op count at which capture='auto' switches from inline "
                   "replay to the scanned task interpreter")
+mca.register("capture_auto_defer", True,
+             "Per-region capture deferral (ISSUE 10): a wait()-delimited "
+             "insert window that turns out not to be capturable (a "
+             "jit=False insert, a non-traceable argument) replays through "
+             "the scheduler — where device bodies ride the async device "
+             "lane — instead of aborting the run; capturable windows "
+             "still compile whole. 0 restores the hard reject", type=bool)
+
+
+class CaptureDeferred(Exception):
+    """Raised by :meth:`GraphCapture.record` when the current insert
+    window cannot be captured and ``--mca capture_auto_defer`` is on: the
+    taskpool replays the recorded prefix as ordinary scheduler inserts
+    and runs the rest of the window interpreted (capture re-arms at the
+    next window). Capture then WINS where it applies — whole-DAG XLA
+    compilation for device-only regions — instead of losing globally to
+    a single non-capturable task."""
 
 #: process-wide compiled-program cache: the same DAG shape (op sequence,
 #: tile shapes/dtypes, scalar params) compiles exactly once. Keys hold the
@@ -101,34 +118,81 @@ class GraphCapture:
         #: per op: (fn, spec); spec entries are
         #: ("flow", tile_index, access) | ("scalar", value) | ("array", arr)
         self.ops: List[Tuple[Any, List[Tuple]]] = []
+        #: per op, parallel to ``ops``: the insert properties capture
+        #: itself ignores but a DEFER replay must restore —
+        #: (priority, where, name, raw per-flow accesses incl. AFFINITY)
+        self.op_extras: List[Tuple] = []
         self._tiles: List[Any] = []          # DTDTile, first-use order
         self._tile_ix: Dict[int, int] = {}   # id(tile) -> index
         self.cache_hit = False
         self.executions = 0
         self.last_mode: Optional[str] = None   # strategy of the last execute
 
+    def _clear_recording(self) -> None:
+        """Consume the recorded batch (execute, mesh-reject, take_ops)."""
+        self.ops = []
+        self.op_extras = []
+        self._tiles = []
+        self._tile_ix = {}
+
     # ------------------------------------------------------------ recording
-    def record(self, fn, args: Sequence[Any], jit: bool, name: str) -> None:
+    def record(self, fn, args: Sequence[Any], jit: bool, name: str,
+               priority: int = 0, where: Optional[int] = None) -> None:
         from .dtd import AFFINITY, DTDTile, RW
+        defer = mca.get("capture_auto_defer", True)
         if not jit:
+            if defer:
+                raise CaptureDeferred(
+                    f"insert of {name or fn!r} passed jit=False")
             output.fatal(f"graph capture requires jit-traceable bodies "
                          f"(insert of {name or fn!r} passed jit=False)")
         spec: List[Tuple] = []
-        for a in args:
+        raw_accs: List[int] = []     # original access bits incl. AFFINITY:
+        for a in args:               # a defer replay must restore them
             if isinstance(a, tuple) and len(a) == 2 and isinstance(a[0], DTDTile):
                 tile, acc = a
+                raw_accs.append(acc)
                 acc &= ~AFFINITY           # placement is moot on one chip
                 spec.append(("flow", self._tile_index(tile), acc))
             elif isinstance(a, DTDTile):
+                raw_accs.append(RW)
                 spec.append(("flow", self._tile_index(a), RW))
             elif isinstance(a, (int, float, np.number)):
                 spec.append(("scalar", a))
             elif isinstance(a, np.ndarray) or hasattr(a, "dtype"):
                 spec.append(("array", a))
             else:
+                if defer:
+                    raise CaptureDeferred(
+                        f"argument {a!r} of {name or fn!r} is not traceable")
                 output.fatal(f"graph capture: argument {a!r} of "
                              f"{name or fn!r} is not traceable")
         self.ops.append((fn, spec))
+        self.op_extras.append((priority, where, name, tuple(raw_accs)))
+
+    def take_ops(self) -> List[Tuple]:
+        """Hand the recorded region back as replayable
+        ``(fn, args, priority, where, name)`` inserts and reset the
+        recording — the auto-defer hand-off: the deferring taskpool
+        re-inserts them through the scheduler in the original program
+        order (DTD sequential consistency makes that a valid
+        serialization) with their original priorities, placement, and
+        affinity bits, so nothing recorded before the non-capturable
+        insert is lost, reordered, or re-scheduled differently."""
+        out: List[Tuple] = []
+        for (fn, spec), (prio, where, name, raw_accs) in zip(
+                self.ops, self.op_extras):
+            args: List[Any] = []
+            fi = 0
+            for e in spec:
+                if e[0] == "flow":
+                    args.append((self._tiles[e[1]], raw_accs[fi]))
+                    fi += 1
+                else:
+                    args.append(e[1])
+            out.append((fn, args, prio, where, name))
+        self._clear_recording()
+        return out
 
     def _tile_index(self, tile) -> int:
         ix = self._tile_ix.get(id(tile))
@@ -447,9 +511,7 @@ class GraphCapture:
             if plan is None:
                 # deterministic config error: consume the batch FIRST so
                 # close()/fini() don't re-raise or hang on the open action
-                self.ops = []
-                self._tiles = []
-                self._tile_ix = {}
+                self._clear_recording()
                 output.fatal("scan capture rejected: "
                              + (getattr(self, "_scan_reject", None)
                                 or "recording is not scannable"))
@@ -484,9 +546,7 @@ class GraphCapture:
         self.executions += 1
         # consume: a later insert batch into the same pool starts a fresh
         # capture (wait() executes each batch exactly once)
-        self.ops = []
-        self._tiles = []
-        self._tile_ix = {}
+        self._clear_recording()
 
     def mesh_hlo(self) -> str:
         """Compiled (post-GSPMD) HLO text of the last mesh execution — the
@@ -561,9 +621,7 @@ class GraphCapture:
             # a batch the mesh path rejected must not linger: close()/wait()
             # would otherwise execute it single-device behind the
             # caller's back
-            self.ops = []
-            self._tiles = []
-            self._tile_ix = {}
+            self._clear_recording()
             raise
 
         coll_names = sorted(colls)
@@ -679,6 +737,4 @@ class GraphCapture:
                 mb, nb = mbnb[name]
                 land(tile, dense_out[name][m*mb:(m+1)*mb, n*nb:(n+1)*nb])
         self.executions += 1
-        self.ops = []
-        self._tiles = []
-        self._tile_ix = {}
+        self._clear_recording()

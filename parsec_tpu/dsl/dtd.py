@@ -87,7 +87,8 @@ mca.register("dtd_batch_insert", True,
 from ..utils.counters import LaneStats as _LaneStats
 
 PTDTD_STATS = _LaneStats(pools_batch=0, tasks_batched=0, tasks_per_task=0,
-                         batches=0, classes_ineligible=0)
+                         batches=0, classes_ineligible=0,
+                         capture_windows_deferred=0)
 
 #: "batch registration not yet attempted" marker for the one-entry class
 #: cache (None means attempted-and-ineligible, which must not retry)
@@ -386,6 +387,10 @@ class DTDTaskpool(Taskpool):
         # termdet can never observe transiently-zero counters at enqueue time
         # (the reference keeps the taskpool's own nb_pending_actions pinned
         # while attached)
+        #: True while the CURRENT insert window is deferred to the
+        #: scheduler (a non-capturable insert poisoned it); wait() resets
+        #: it so the next window captures again (per-region auto-defer)
+        self._capture_deferred = False
         # whole-DAG capture mode (dsl/capture.py): record inserts, execute
         # the entire pool as ONE jitted XLA program at wait()
         self._capture = None
@@ -1110,10 +1115,34 @@ class DTDTaskpool(Taskpool):
             # chain-order guarantee: buffered batch specs precede this
             # task in program order, so they must link first
             self._flush_batch_locked()
-        if self._capture is not None:
-            self._capture.record(fn, args, jit=jit, name=name or "")
-            self.inserted += 1
-            return None
+        if self._capture is not None and not self._capture_deferred:
+            from .capture import CaptureDeferred
+            try:
+                self._capture.record(fn, args, jit=jit, name=name or "",
+                                     priority=priority, where=where)
+                self.inserted += 1
+                return None
+            except CaptureDeferred as e:
+                # per-region auto-defer (ISSUE 10): this wait()-delimited
+                # window holds a non-capturable insert — replay the
+                # recorded prefix through the scheduler in program order
+                # (device bodies then ride the device module / ptdev
+                # lane) and run the REST of the window interpreted too;
+                # capture re-arms at the next window, so capture wins
+                # where it applies instead of losing globally
+                output.debug_verbose(1, "capture",
+                                     f"{self.name}: window deferred to "
+                                     f"the scheduler ({e})")
+                self._capture_deferred = True
+                PTDTD_STATS["capture_windows_deferred"] += 1
+                replays = self._capture.take_ops()
+                self.inserted -= len(replays)   # re-counted by the replay
+                for rfn, rargs, rprio, rwhere, rname in replays:
+                    self._insert_task_locked(rfn, rargs, rprio,
+                                             DEV_ALL if rwhere is None
+                                             else rwhere, rname or None,
+                                             True, False)
+                # fall through: THIS task inserts normally below
         flow_accesses: List[int] = []
         arg_spec: List[Tuple[str, Any]] = []
         tiles: List[DTDTile] = []
@@ -1646,8 +1675,13 @@ class DTDTaskpool(Taskpool):
     def wait(self, timeout: Optional[float] = None) -> bool:
         """parsec_dtd_taskpool_wait: drain everything this rank executes."""
         if self._capture is not None:
-            self._capture.execute()
-            return True
+            if not self._capture_deferred:
+                self._capture.execute()
+                return True
+            # deferred window: the region's tasks went through the
+            # scheduler — drain them like an uncaptured pool, then re-arm
+            # capture for the next window
+            self._capture_deferred = False
         if self._audit and self.ctx.comm is not None and self.ctx.nb_ranks > 1:
             # replay audit BEFORE blocking on completion: a divergent insert
             # sequence surfaces as a fatal here instead of a silent hang

@@ -71,7 +71,8 @@ mca.register("ptg_native_exec", True,
 from ...utils.counters import LaneStats as _LaneStats
 
 PTEXEC_STATS = _LaneStats(pools_engaged=0, tasks_engaged=0,
-                          pools_fallback=0, pools_ineligible=0)
+                          pools_fallback=0, pools_ineligible=0,
+                          pools_device=0, tasks_device=0)
 
 _ACCESS_MAP = {
     P.FLOW_READ: FLOW_ACCESS_READ,
@@ -862,19 +863,31 @@ class PTGTaskpool(Taskpool):
         return n
 
     # ------------------------------------------------------- native exec lane
+    def _ptexec_class_device(self, tc: TaskClass) -> bool:
+        """True for the TPU-bodied shape (``BODY [type=TPU]``): exactly
+        the two ungated incarnations _build_class emits — the TPU chore
+        plus its CPU twin running the same jitted function."""
+        incs = tc.incarnations
+        return (len(incs) == 2 and incs[0].device_type == DEV_TPU
+                and incs[1].device_type == DEV_CPU
+                and incs[0].evaluate is None and incs[1].evaluate is None)
+
     def _ptexec_class_eligible(self, tc: TaskClass) -> bool:
         """May this class's whole FSM run inside the native lane
         (native/src/ptexec.cpp)?  Eligibility = the per-task cycle carries
         no state the lane does not model. The lane models: CTL edges, DATA
         flows (the versioned slot hand-off + the datarepo usagelmt/usagecnt
         retire protocol live in the lane's per-task slot array), memory
-        reads/write-backs, and ``priority`` properties (a native ready
-        heap). It does NOT model: named datatypes (reshape promises),
-        device/chore selection (exactly one ungated CPU chore required —
-        TPU-bodied classes carry two incarnations and stay on the Python
-        FSM), multi-body classes, or custom startup seeding. Pool-level
-        gates (distributed ranks, PINS, paranoid) live in
-        :meth:`_ptexec_prepare`."""
+        reads/write-backs, ``priority`` properties (a native ready heap),
+        and — eligibility v3, ISSUE 10 — TPU-bodied classes: their tasks
+        surface onto the native DEVICE lane (ptdev) when one is up, or run
+        the same jitted function through the CPU dispatch when no
+        accelerator device exists (which is exactly what the interpreted
+        FSM's chore selection would have picked). It does NOT model: named
+        datatypes (reshape promises), evaluate-gated or >2-incarnation
+        chore selection, multi-body classes, or custom startup seeding.
+        Pool-level gates (distributed ranks, PINS, paranoid, device-lane
+        availability) live in :meth:`_ptexec_prepare`."""
         if getattr(tc, "_ptg_startup_fn", None) is not None:
             return False
         if tc._ptg_spec.header_props.get("make_key_fn") is not None:
@@ -884,7 +897,13 @@ class PTGTaskpool(Taskpool):
             return False
         if len(tc._ptg_spec.bodies) != 1:
             return False
-        if len(tc.incarnations) != 1 or \
+        if self._ptexec_class_device(tc):
+            if tc.time_estimate is not None:
+                # a user ETA hook feeds best-device selection — machinery
+                # the lane bypasses; calling (or silently not calling) a
+                # user hook is observable behavior (the make_key_fn rule)
+                return False
+        elif len(tc.incarnations) != 1 or \
                 tc.incarnations[0].device_type != DEV_CPU or \
                 tc.incarnations[0].evaluate is not None:
             return False
@@ -1150,11 +1169,39 @@ class PTGTaskpool(Taskpool):
         for tc in classes:
             if not self._ptexec_class_eligible(tc):
                 return None
+        dev_classes = [self._ptexec_class_device(tc) for tc in classes]
+        use_dev = False
+        if any(dev_classes):
+            # eligibility v3 (ISSUE 10): TPU-bodied classes. With an
+            # accelerator device registered their tasks surface onto the
+            # native DEVICE lane (ptdev); without one, the CPU twin of
+            # the same jitted body runs through the ordinary lane
+            # dispatch — exactly the chore the interpreted FSM's device
+            # selection would pick on a CPU-only host.
+            if ctx.devices.by_type(DEV_TPU):
+                from ...device.native import PTDEV_STATS
+                if lane_comm is not None or not mca.get("device_native",
+                                                        True):
+                    # device + cross-rank lanes are not combined yet, and
+                    # --mca device_native 0 keeps the interpreted device
+                    # module: both ineligible-by-design
+                    PTDEV_STATS["pools_ineligible"] += 1
+                    return None
+                use_dev = True
         self._ptexec_refusal = "fallback"
         from ... import native as native_mod
         mod = native_mod.load_ptexec()
         if mod is None:
             return None
+        devlane = None
+        if use_dev:
+            devlane = ctx._ptdev_lane()
+            if devlane is None:
+                # eligible, device present, but the ptdev module/lane is
+                # missing: the silent-regression signal
+                from ...device.native import PTDEV_STATS
+                PTDEV_STATS["pools_fallback"] += 1
+                return None
         names = tuple(tc._ptg_spec.name for tc in classes)
         key = self._ptexec_cache_key(names)
         cache = self.program.__dict__.setdefault("_ptexec_cache", {})
@@ -1240,7 +1287,176 @@ class PTGTaskpool(Taskpool):
             flat, classes, slots, mem_datas, writebacks,
             comm=None if comm_info is None else dict(
                 comm_info, lane=lane_comm, pool_id=lane["pool_id"]))
+        if use_dev:
+            # bind LAST: dev_bind surfaces zero-dep device seeds onto the
+            # lane immediately, and the manager may dispatch them before
+            # this function returns — every closure it touches (slots,
+            # mem_datas, writebacks) exists by now
+            self._ptexec_bind_dev(lane, devlane, flat, classes,
+                                  dev_classes, slots, mem_datas, writebacks)
         return lane
+
+    def _ptexec_bind_dev(self, lane: Dict[str, Any], devlane, flat,
+                         classes: List[TaskClass], dev_classes: List[bool],
+                         slots: List[Any], mem_datas,
+                         writebacks: Dict[int, List]) -> None:
+        """Bind a flattened data graph to the native device lane (ISSUE
+        10): build the per-pool dispatch/poll closures, register them
+        with the lane (the retire capsule routes completions back into
+        the graph's GIL-free release walk), and hand the graph the submit
+        vtable + per-task device mask — from then on a device-bodied task
+        becoming ready surfaces onto the lane's MPSC pending queue
+        instead of the ready structure."""
+        data = flat["data"]
+        # only data-carrying TPU classes ride the device plane; a CTL-only
+        # [type=TPU] class has no arrays to place and runs its raw body
+        # through the ordinary CPU dispatch
+        dev_of_class = [d and nd > 0
+                        for d, nd in zip(dev_classes, data["ndflows"])]
+        if not any(dev_of_class):
+            return
+        dev_mask: List[int] = []
+        for ci, insts in enumerate(flat["params"]):
+            dev_mask.extend([1 if dev_of_class[ci] else 0] * len(insts))
+        ndev = sum(dev_mask)
+        graph = lane["graph"]
+        dispatch, poll = self._mk_ptexec_dev_dispatch(
+            flat, classes, dev_of_class, slots, mem_datas, writebacks,
+            devlane)
+        pid = devlane.bind_pool(graph, dispatch, poll)
+        lane["dev"] = devlane
+        lane["dev_pool"] = pid
+        from ...device.native import PTDEV_STATS
+        PTDEV_STATS["pools_engaged"] += 1
+        PTDEV_STATS["tasks_engaged"] += ndev
+        PTEXEC_STATS["pools_device"] += 1
+        PTEXEC_STATS["tasks_device"] += ndev
+        graph.dev_bind(devlane.submit_capsule(), pid, dev_mask)
+        devlane.clane.notify()
+
+    def _mk_ptexec_dev_dispatch(self, flat, classes: List[TaskClass],
+                                dev_of_class: List[bool], slots: List[Any],
+                                mem_datas, writebacks: Dict[int, List],
+                                devlane):
+        """The device lane's per-pool dispatch/poll pair, both run on the
+        lane's manager thread with the GIL held:
+
+        * ``dispatch(ids)`` — the push+exec phases of the reference's
+          stream pipeline (device_gpu.c:3438), collapsed onto XLA's async
+          runtime: FIRST every memory-endpoint input of the whole batch
+          stages in (version-checked through the C coherency table;
+          ``device_put`` is asynchronous, so these H2D transfers overlap
+          whatever compute is already in flight — the early-push overlap
+          the interpreted path never had), THEN each task's jitted body
+          dispatches (async) and its future outputs land in the lane's
+          slot array immediately — safe because no consumer can run
+          before this task RETIRES, which only happens after its
+          completion events fire;
+        * ``poll()`` — the event queue: ``jax.Array.is_ready`` over each
+          inflight task's outputs (cudaEventQuery, device_gpu.c:2593).
+          Completed tasks perform their memory write-backs + version
+          bumps, drop their stage-in pins, and return their ids — the C
+          side then calls the graph's GIL-free ``dev_retire``.
+        """
+        from ...data.data import COHERENCY_OWNED as _OWNED
+        dev = devlane.device
+        bases = flat["bases"]
+        params_by_class = flat["params"]
+        data = flat["data"]
+        slot_base = data["slot_base"]
+        in_refs = data["in_refs"]
+        ndflows = data["ndflows"]
+        cls_of = data["cls_of"]
+        fns, written_by_class = [], []
+        for ci, tc in enumerate(classes):
+            empty = tc._ptg_spec.bodies[0].source.strip() in ("", "pass")
+            fns.append(None if empty or not dev_of_class[ci]
+                       else tc._ptg_body_fn)
+            written_by_class.append(tuple(
+                dj for dj, fi in enumerate(data["dflow_idx"][ci])
+                if tc.flows[fi].access & FLOW_ACCESS_WRITE))
+        import collections as _collections
+        inflight: "_collections.deque" = _collections.deque()
+
+        def dispatch(ids):
+            # PUSH phase: issue every memory-endpoint stage-in for the
+            # whole batch before any compute dispatch. Each staged copy is
+            # pinned THE MOMENT it stages: under a tight budget, staging
+            # tile k+1 of this very batch can otherwise evict tile k
+            # before the exec phase reads it (found by the verify drive —
+            # "dot got NoneType"). Batch pins release after the exec
+            # phase has taken its per-task inflight pins.
+            staged: Dict[int, Any] = {}
+            batch_pins: List[Any] = []
+            for i in ids:
+                base = slot_base[i]
+                for dj in range(ndflows[cls_of[i]]):
+                    r = in_refs[base + dj]
+                    if r < -1 and (-2 - r) not in staged:
+                        mi = -2 - r
+                        # pin=True: the eviction pin is taken inside the
+                        # table's reserve critical section, so no peer
+                        # thread's stage-in can evict this entry first
+                        copy = dev.lane_stage_in(mem_datas[mi], pin=True)
+                        batch_pins.append(copy)
+                        staged[mi] = copy
+            # EXEC phase: dispatch each ready device task asynchronously
+            for i in ids:
+                k = cls_of[i]
+                base = slot_base[i]
+                nd = ndflows[k]
+                vals: List[Any] = []
+                pins: List[Any] = []
+                for dj in range(nd):
+                    r = in_refs[base + dj]
+                    if r >= 0:
+                        vals.append(slots[r])
+                    elif r == -1:
+                        vals.append(None)
+                    else:
+                        copy = staged[-2 - r]
+                        dev.pin_copy(copy)     # readers guard while inflight
+                        pins.append(copy)
+                        vals.append(copy.payload)
+                fn = fns[k]
+                events = ()
+                if fn is not None:
+                    outs = fn(*params_by_class[k][i - bases[k]], *vals)
+                    for oj, dj in enumerate(written_by_class[k]):
+                        vals[dj] = outs[oj]
+                    events = tuple(v for v in outs
+                                   if hasattr(v, "is_ready"))
+                for dj in range(nd):
+                    slots[base + dj] = vals[dj]
+                inflight.append((i, events, writebacks.get(i), vals, pins))
+            for copy in batch_pins:         # per-task pins hold from here
+                dev.unpin_copy(copy)
+            return len(ids)
+
+        def poll():
+            done: List[int] = []
+            for _ in range(len(inflight)):
+                ent = inflight.popleft()
+                i, events, wbs, vals, pins = ent
+                if events and not all(a.is_ready() for a in events):
+                    inflight.append(ent)
+                    continue
+                if wbs:
+                    for dj, dref in wbs:
+                        v = vals[dj]
+                        host = dref.get_copy(0)
+                        if host is None:
+                            dref.create_copy(0, v, _OWNED)
+                        else:
+                            host.payload = v
+                        dref.bump_version(0)
+                for copy in pins:
+                    dev.unpin_copy(copy)
+                dev.executed_tasks += 1
+                done.append(i)
+            return done
+
+        return dispatch, poll
 
     def _ptexec_owners(self, classes: List[TaskClass],
                        flat) -> Optional[List[int]]:
@@ -1538,6 +1754,10 @@ class PTGTaskpool(Taskpool):
             # stop routing this pool's frames; parked payloads (already
             # consumed or unreachable) drop with the registration
             lane["comm"].unregister_engine(lane["pool_id"])
+        if lane.get("dev_pool") is not None:
+            # every device task retired (the graph is done), so the lane
+            # owes this pool nothing; drop the routing + the engine pin
+            lane["dev"].unbind_pool(lane["dev_pool"])
         slots = lane.get("slots")
         if slots:
             # lane-side datarepo accounting into the counter registry

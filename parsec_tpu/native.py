@@ -124,6 +124,7 @@ _ptdtd_mod = [None, False]   # [module, attempted]
 _ptexec_mod = [None, False]
 _ptcomm_mod = [None, False]
 _ptsched_mod = [None, False]
+_ptdev_mod = [None, False]
 
 
 def _load_pyext(stem: str, cache):
@@ -208,6 +209,15 @@ def load_ptsched():
     admission windows — the shared ready plane the ptexec/ptdtd engines
     drain through when a Context arms it (docs/scheduling.md)."""
     return _load_pyext("_ptsched", _ptsched_mod)
+
+
+def load_ptdev():
+    """The CPython-extension device lane (native/src/ptdev.cpp), or None.
+    Per-device async dispatch queues fed GIL-free from the engines'
+    release sweeps, a manager thread issuing JAX dispatch and polling
+    completion events, GIL-free retirement back into the engines, and the
+    C-side coherency/residency table (docs/device_lane.md)."""
+    return _load_pyext("_ptdev", _ptdev_mod)
 
 
 class NativeDepTable:

@@ -103,6 +103,7 @@ def install_native_counters() -> None:
     snapshot export see the lanes. Idempotent."""
     from ..comm import native as _cnative        # lazy: avoid import cycles
     from ..core import sched_plane as _sp
+    from ..device import native as _dnative
     from ..dsl import dtd as _dtd
     from ..dsl.ptg import compiler as _ptg
     from . import native_trace as _nt
@@ -114,6 +115,7 @@ def install_native_counters() -> None:
     for stats, prefix in ((_ptg.PTEXEC_STATS, "ptexec"),
                           (_dtd.PTDTD_STATS, "ptdtd"),
                           (_cnative.PTCOMM_STATS, "ptcomm"),
+                          (_dnative.PTDEV_STATS, "ptdev"),
                           (_sp.SCHED_STATS, "sched")):
         for key in stats:
             counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
@@ -121,6 +123,15 @@ def install_native_counters() -> None:
     for key in _cnative.COMM_COUNTER_KEYS:
         counters.register(f"ptcomm.{key}",
                           sampler=_cnative.comm_counter_sampler(key))
+    # the device lane's C-side counters: dispatch/retire/overlap splits
+    # from the Lane, residency/eviction/stage-in from the CohTable —
+    # ISSUE 10's "device occupancy shows up on /metrics"
+    for key in _dnative.DEV_COUNTER_KEYS:
+        counters.register(f"ptdev.{key}",
+                          sampler=_dnative.dev_counter_sampler(key))
+    for key in _dnative.COH_COUNTER_KEYS:
+        counters.register(f"ptdev.{key}",
+                          sampler=_dnative.coh_counter_sampler(key))
     # the scheduler plane's C-side counters (summed across live planes):
     # steals, spills, served, queued, admission stalls — ISSUE 9
     for key in _sp.PLANE_COUNTER_KEYS:

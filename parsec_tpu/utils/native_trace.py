@@ -68,6 +68,11 @@ NATIVE_KEYWORDS: Dict[str, Dict[int, str]] = {
                # sender with frame_rx on the receiver into Perfetto flow
                # arrows, one causal edge per cross-rank activation frame
                7: "ptcomm::frame_tx", 8: "ptcomm::frame_rx"},
+    # the device lane's manager-thread events (native/src/ptdev.cpp):
+    # dispatch batches as intervals, per-task retirements as points —
+    # device occupancy/overlap in the same Perfetto view as the engines
+    # (`ptdev-w*` streams; one ring, the manager is a single thread)
+    "ptdev": {1: "ptdev::dispatch", 2: "ptdev::retire"},
 }
 
 #: live bridges, for the process-wide drop/landed samplers

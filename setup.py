@@ -49,6 +49,8 @@ setup(
                   libraries=["rt"]),
         Extension("parsec_tpu._ptsched", ["native/src/ptsched.cpp"],
                   extra_compile_args=["-O3", "-std=c++17"]),
+        Extension("parsec_tpu._ptdev", ["native/src/ptdev.cpp"],
+                  extra_compile_args=["-O3", "-std=c++17"]),
         Extension("parsec_tpu._ptcore", ["native/src/ptcore.cpp"],
                   extra_compile_args=["-O3", "-std=c++17"]),
     ],

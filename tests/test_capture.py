@@ -115,11 +115,16 @@ def test_capture_program_cache(ctx):
 
 
 def test_capture_rejects_nonjit_and_multirank(ctx):
-    cap = DTDTaskpool(ctx, "cap-neg", capture=True)
-    t = cap.tile_new((4, 4), np.float32)
-    with pytest.raises(RuntimeError, match="jit-traceable"):
-        cap.insert_task(lambda x: x, (t, RW), jit=False)
-    cap.close()
+    from parsec_tpu.utils import mca as _mca
+    _mca.set("capture_auto_defer", False)   # restore the hard reject
+    try:
+        cap = DTDTaskpool(ctx, "cap-neg", capture=True)
+        t = cap.tile_new((4, 4), np.float32)
+        with pytest.raises(RuntimeError, match="jit-traceable"):
+            cap.insert_task(lambda x: x, (t, RW), jit=False)
+        cap.close()
+    finally:
+        _mca.params.unset("capture_auto_defer")
 
     from parsec_tpu.comm.remote_dep import RemoteDepEngine
     from parsec_tpu.comm.threads import ThreadsCE, run_distributed
@@ -738,3 +743,38 @@ def test_scan_matching_dtypes_still_scans(ctx):
     cap.close()
     ctx.wait(timeout=30)
     np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload), 8.0)
+
+
+def test_capture_auto_defers_noncapturable_window(ctx):
+    """Per-region auto-defer (ISSUE 10): a window poisoned by a jit=False
+    insert replays through the scheduler — the recorded prefix keeps its
+    program order, results match a captured run — and the NEXT window
+    captures again."""
+    from parsec_tpu.dsl.dtd import PTDTD_STATS
+    cap = DTDTaskpool(ctx, "cap-defer", capture=True)
+    t = cap.tile_new((4, 4), np.float32)
+    t.data.create_copy(0, np.ones((4, 4), np.float32))
+    snap = PTDTD_STATS.snapshot()
+    # window 1: two capturable inserts, then one that defeats capture
+    cap.insert_task(lambda x: x * 2.0, (t, RW))
+    cap.insert_task(lambda x: x + 1.0, (t, RW))
+
+    def host_body(x):
+        return np.asarray(x) + 0.5          # numpy: not jit-traceable
+
+    cap.insert_task(host_body, (t, RW), jit=False)
+    assert cap._capture_deferred
+    assert PTDTD_STATS.delta(snap)["capture_windows_deferred"] == 1
+    assert cap._capture.ops == []           # prefix handed to the scheduler
+    cap.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload),
+                               1.0 * 2.0 + 1.0 + 0.5)
+    # window 2: capture re-armed — a capturable window compiles whole
+    assert not cap._capture_deferred
+    cap.insert_task(lambda x: x * 3.0, (t, RW))
+    assert len(cap._capture.ops) == 1
+    cap.wait(timeout=30)
+    cap.close()
+    ctx.wait(timeout=30)
+    np.testing.assert_allclose(np.asarray(t.data.newest_copy().payload),
+                               3.5 * 3.0)

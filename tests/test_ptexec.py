@@ -20,7 +20,7 @@ import pytest
 
 import parsec_tpu as pt
 from parsec_tpu import native as native_mod
-from parsec_tpu.dsl.ptg.compiler import compile_ptg
+from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS, compile_ptg
 from parsec_tpu.utils import mca
 
 pytestmark = pytest.mark.skipif(native_mod.load_ptexec() is None,
@@ -632,9 +632,12 @@ def test_lane_fallback_typed_deps():
         ctx.fini()
 
 
-def test_lane_fallback_tpu_body_class():
-    """A TPU body registers two chores (TPU + CPU degrade) — device
-    selection is policy the lane does not model; Python FSM keeps it."""
+def test_lane_admits_tpu_body_class():
+    """Eligibility v3 (ISSUE 10): a TPU body no longer ejects the pool
+    from the lane. On a CPU-only host (no accelerator device registered)
+    its CPU-twin chore runs through the ordinary lane dispatch — the same
+    choice the interpreted FSM's device selection would make — so the
+    pool stays native with zero device-lane involvement."""
     import numpy as np
     from parsec_tpu.data.matrix import TiledMatrix
 
@@ -645,14 +648,21 @@ def test_lane_fallback_tpu_body_class():
            "BODY [type=TPU]\n  X = X + 1.0\nEND\n")
     ctx = pt.Context(nb_cores=1)
     try:
+        from parsec_tpu.core.task import DEV_TPU
+        assert not ctx.devices.by_type(DEV_TPU), \
+            "this test expects a CPU-only context (no over_cpu device)"
         A = TiledMatrix("laneA", 1, 4, 1, 1)
         A.fill(lambda m, k: np.zeros((1, 1), np.float32))
         prog = compile_ptg(src, "tpu-body")
+        snap = PTEXEC_STATS.snapshot()
         tp = prog.instantiate(ctx, globals={"NT": 4},
                               collections={"descA": A})
         ctx.add_taskpool(tp)
         ctx.wait(timeout=60)
-        assert tp._ptexec_state is None
+        assert tp._ptexec_state is not None, \
+            "TPU-bodied pool fell off the lane on a CPU-only host"
+        delta = PTEXEC_STATS.delta(snap)
+        assert delta["pools_engaged"] == 1 and delta["pools_device"] == 0
         np.testing.assert_allclose(
             np.asarray(A.data_of(0, 3).newest_copy().payload), 4.0)
     finally:
