@@ -124,6 +124,15 @@ echo "== native device lane engagement smoke (over_cpu) =="
 # zero coherency violations in the C residency table, bit-correct GEMM
 JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/zone_bench.py --ci-gate
 
+echo "== cross-rank serving fabric engagement smoke (ptfab, 2 ranks) =="
+# ISSUE 11: credit grants/spends must be nonzero ON THE WIRE with zero
+# frame errors (spends local — frames don't scale with spends), remote
+# nowait inserts must raise under an exhausted window, the victim tenant
+# must keep being served under a mesh-wide antagonist flood, and the
+# rank-0 reconciliation loop must land cross-rank shares within
+# tolerance of the global weights. Engagement counters, not timing.
+JAX_PLATFORMS=cpu timeout 420 python3 benchmarks/serving.py --fab-gate
+
 echo "== native comm lane engagement smoke (2 ranks) =="
 # same contract as the execution-lane gates: assert ENGAGEMENT, not
 # throughput — a 2-OS-rank chain whose every edge crosses ranks must ride

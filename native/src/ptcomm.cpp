@@ -82,6 +82,12 @@ constexpr uint32_t EV_COMM_REP = 6;      // POINT, id = payload bytes served
 // ingest and draw one causal flow arrow per cross-rank activation frame
 constexpr uint32_t EV_COMM_FRAME_TX = 7;
 constexpr uint32_t EV_COMM_FRAME_RX = 8;
+// serving-fabric credit flow (ISSUE 11): one POINT per K_CRED frame on
+// each end, id = credit count (grants positive, returns negative), so
+// merged Perfetto timelines pair admission-control traffic with the
+// ACT/DATA frames it gates
+constexpr uint32_t EV_FAB_CRED_TX = 9;
+constexpr uint32_t EV_FAB_CRED_RX = 10;
 constexpr uint64_t FRAME_SEQ_MASK = (1ull << 40) - 1;
 
 inline int64_t frame_flow_id(int peer, uint64_t seq) {
@@ -106,6 +112,8 @@ constexpr uint8_t K_RDV = 4;     // body = meta; aux = sender handle
 constexpr uint8_t K_GETREQ = 5;  // aux = handle (pool/arg echoed)
 constexpr uint8_t K_GETREP = 6;  // body = payload; aux = handle
 constexpr uint8_t K_BYE = 7;
+constexpr uint8_t K_CRED = 8;    // admission credits; layout/flags in
+                                 // ptcomm_iface.h (serving fabric)
 // queue-internal only (batched into K_ACTS at drain):
 constexpr uint8_t K_ACT_ONE = 100;
 
@@ -157,6 +165,7 @@ struct SendOp {
     SendOp *next = nullptr;
     int32_t dst = 0;
     uint8_t kind = 0;
+    uint8_t flags = 0;         // K_CRED: PTCOMM_CRED_GRANT / _RETURN
     uint32_t pool = 0, arg = 0;
     uint64_t aux = 0;
     int64_t t_enq = 0;         // enqueue stamp (act_queue_ns histogram)
@@ -215,6 +224,17 @@ struct Comm {
     std::vector<RdvReg *> *rdv_release;  // reaped under the GIL
     uint64_t next_handle;
 
+    // serving-fabric credit ledgers (ISSUE 11), keyed (pool << 32 |
+    // tenant) per peer rank. `cred_avail[r]`: credits THIS rank may
+    // spend toward rank r (inserter side; cred_take debits locally —
+    // the zero-round-trip hot path). `cred_out[r]`: credits this rank
+    // GRANTED to rank r and not yet returned/reclaimed (target side;
+    // the pool's admission headroom reserves them). Both touched under
+    // cred_mu by Python calls AND the progress thread's K_CRED dispatch.
+    std::mutex *cred_mu;
+    std::vector<std::unordered_map<uint64_t, int64_t>> *cred_avail;
+    std::vector<std::unordered_map<uint64_t, int64_t>> *cred_out;
+
     // stats (relaxed atomics, sampled by stats())
     std::atomic<int64_t> acts_tx, acts_rx, act_frames_tx, act_frames_rx;
     std::atomic<int64_t> data_tx, data_rx, rdv_tx, rdv_rx;
@@ -222,6 +242,10 @@ struct Comm {
     std::atomic<int64_t> bytes_tx, bytes_rx;
     std::atomic<int64_t> frame_errors, early_parked, dropped_sends;
     std::atomic<int64_t> late_frames;   // frames for retired pools, dropped
+    std::atomic<int64_t> creds_granted_tx, creds_granted_rx;
+    std::atomic<int64_t> creds_spent, creds_reclaimed;
+    std::atomic<int64_t> creds_returned_tx, creds_returned_rx;
+    std::atomic<int64_t> cred_frames_tx, cred_frames_rx;
     std::atomic<int64_t> wakeups, loops;
     std::atomic<int64_t> out_pending;  // bytes queued but not yet on a wire
 
@@ -242,6 +266,10 @@ inline pthist::State<N_HISTS> *hist_of(Comm *self) {
 
 uint64_t pay_key(uint32_t pool, uint32_t slot) {
     return ((uint64_t)pool << 32) | slot;
+}
+
+uint64_t cred_key(uint32_t pool, uint32_t tenant) {
+    return ((uint64_t)pool << 32) | tenant;
 }
 
 void sq_push(Comm *self, SendOp *op) {
@@ -282,11 +310,12 @@ extern "C" void comm_send_act_c(void *comm, int32_t dst, uint32_t pool,
 
 void put_frame(Comm *self, Peer *p, uint8_t kind, uint32_t pool,
                uint32_t arg, uint64_t aux, const void *b1, size_t l1,
-               const void *b2 = nullptr, size_t l2 = 0) {
+               const void *b2 = nullptr, size_t l2 = 0,
+               uint8_t flags = 0) {
     WireHdr h;
     h.body_len = (uint32_t)(l1 + l2);
     h.kind = kind;
-    h.flags = 0;
+    h.flags = flags;
     h.src = (uint16_t)self->my_rank;
     h.pool = pool;
     h.arg = arg;
@@ -435,6 +464,16 @@ int drain_sendq(Comm *self, ptrace_ring::Writer &tw) {
                 }
                 break;
             }
+            case K_CRED:
+                put_frame(self, p, K_CRED, op->pool, op->arg, op->aux,
+                          nullptr, 0, nullptr, 0, op->flags);
+                self->cred_frames_tx.fetch_add(1, std::memory_order_relaxed);
+                if (tw.st)
+                    tw.rec(EV_FAB_CRED_TX,
+                           op->flags == PTCOMM_CRED_RETURN
+                               ? -(int64_t)op->aux : (int64_t)op->aux,
+                           ptrace_ring::FLAG_POINT);
+                break;
             case K_BYE:
                 put_frame(self, p, K_BYE, 0, 0, 0, nullptr, 0);
                 break;
@@ -726,6 +765,44 @@ void dispatch_frame(Comm *self, Peer *p, const WireHdr &h, const char *body,
                        ptrace_ring::FLAG_POINT);
             return;
         }
+        case K_CRED: {
+            // admission credits are comm-level (they gate INSERTION, not
+            // the engines), so no pool registration is consulted: the
+            // ledgers update straight from the progress thread. h.src is
+            // wire-supplied and indexes the per-rank ledger vectors, so
+            // an out-of-range src is a malformed frame, not an index
+            if (h.body_len != 0 || h.aux == 0 ||
+                (int)h.src >= self->nb_ranks) {
+                self->frame_errors.fetch_add(1, std::memory_order_relaxed);
+                return;
+            }
+            int64_t n = (int64_t)h.aux;
+            uint64_t key = cred_key(h.pool, h.arg);
+            {
+                std::lock_guard<std::mutex> lk(*self->cred_mu);
+                if (h.flags == PTCOMM_CRED_RETURN) {
+                    // an inserter handed unspent credits back: shrink the
+                    // outstanding ledger (floor 0: a return racing a
+                    // reclaim must not go negative)
+                    int64_t &o = (*self->cred_out)[(size_t)h.src][key];
+                    o = o > n ? o - n : 0;
+                } else {
+                    (*self->cred_avail)[(size_t)h.src][key] += n;
+                }
+            }
+            if (h.flags == PTCOMM_CRED_RETURN)
+                self->creds_returned_rx.fetch_add(n,
+                                                  std::memory_order_relaxed);
+            else
+                self->creds_granted_rx.fetch_add(n,
+                                                 std::memory_order_relaxed);
+            self->cred_frames_rx.fetch_add(1, std::memory_order_relaxed);
+            if (tw.st)
+                tw.rec(EV_FAB_CRED_RX,
+                       h.flags == PTCOMM_CRED_RETURN ? -n : n,
+                       ptrace_ring::FLAG_POINT);
+            return;
+        }
         case K_HELLO:
             return;  // duplicate hello: harmless
         default:
@@ -950,12 +1027,23 @@ PyObject *comm_new(PyTypeObject *type, PyObject *args, PyObject *) {
     self->rdv = new (std::nothrow) std::unordered_map<uint64_t, RdvReg *>();
     self->rdv_release = new (std::nothrow) std::vector<RdvReg *>();
     self->next_handle = 1;
+    self->cred_mu = new (std::nothrow) std::mutex();
+    self->cred_avail = new (std::nothrow)
+        std::vector<std::unordered_map<uint64_t, int64_t>>(
+            (size_t)nb_ranks);
+    self->cred_out = new (std::nothrow)
+        std::vector<std::unordered_map<uint64_t, int64_t>>(
+            (size_t)nb_ranks);
     for (std::atomic<int64_t> *c :
          {&self->acts_tx, &self->acts_rx, &self->act_frames_tx,
           &self->act_frames_rx, &self->data_tx, &self->data_rx,
           &self->rdv_tx, &self->rdv_rx, &self->getreq_rx, &self->getrep_rx,
           &self->bytes_tx, &self->bytes_rx, &self->frame_errors,
-          &self->early_parked, &self->dropped_sends, &self->wakeups,
+          &self->early_parked, &self->dropped_sends,
+          &self->creds_granted_tx, &self->creds_granted_rx,
+          &self->creds_spent, &self->creds_reclaimed,
+          &self->creds_returned_tx, &self->creds_returned_rx,
+          &self->cred_frames_tx, &self->cred_frames_rx, &self->wakeups,
           &self->loops})
         new (c) std::atomic<int64_t>(0);
     new (&self->trace) std::atomic<ptrace_ring::State *>(nullptr);
@@ -965,6 +1053,7 @@ PyObject *comm_new(PyTypeObject *type, PyObject *args, PyObject *) {
     if (!self->peers || !self->pools_mu || !self->pools || !self->early ||
         !self->retired || !self->pay_mu || !self->payloads ||
         !self->rdv_mu || !self->rdv || !self->rdv_release ||
+        !self->cred_mu || !self->cred_avail || !self->cred_out ||
         !self->act_seq) {
         Py_DECREF(self);
         PyErr_NoMemory();
@@ -1040,6 +1129,9 @@ void comm_dealloc(PyObject *obj) {
     delete self->rdv_mu;
     delete self->rdv;
     delete self->rdv_release;
+    delete self->cred_mu;
+    delete self->cred_avail;
+    delete self->cred_out;
     delete self->act_seq;
     delete self->trace.load(std::memory_order_acquire);
     delete self->hist.load(std::memory_order_acquire);
@@ -1433,6 +1525,213 @@ PyObject *comm_pins_pending(PyObject *obj, PyObject *) {
     return PyLong_FromSize_t(self->rdv->size());
 }
 
+// ----------------------------------------------------- serving credits
+// (ISSUE 11; frame layout + flag contract in ptcomm_iface.h)
+
+bool check_cred_args(Comm *self, int rank, long long n, bool want_n) {
+    if (rank < 0 || rank >= self->nb_ranks || rank == self->my_rank) {
+        PyErr_SetString(PyExc_ValueError, "bad peer rank");
+        return false;
+    }
+    if (want_n && n <= 0) {
+        PyErr_SetString(PyExc_ValueError, "credit count must be positive");
+        return false;
+    }
+    return true;
+}
+
+// cred_grant(dst, pool, tenant, n): grant n admission credits to rank
+// `dst` for (pool, tenant) — bumps the outstanding ledger and ships a
+// K_CRED frame. The caller (the fabric) reserves matching window
+// headroom on the scheduler plane FIRST.
+PyObject *comm_cred_grant(PyObject *obj, PyObject *args) {
+    Comm *self = reinterpret_cast<Comm *>(obj);
+    int dst;
+    unsigned int pool, tenant;
+    long long n;
+    if (!PyArg_ParseTuple(args, "iIIL", &dst, &pool, &tenant, &n))
+        return nullptr;
+    if (!check_cred_args(self, dst, n, true)) return nullptr;
+    if (!(*self->peers)[(size_t)dst]) {
+        PyErr_SetString(PyExc_ValueError, "no such peer");
+        return nullptr;
+    }
+    SendOp *op = new (std::nothrow) SendOp();
+    if (!op) return PyErr_NoMemory();
+    {
+        std::lock_guard<std::mutex> lk(*self->cred_mu);
+        (*self->cred_out)[(size_t)dst][cred_key(pool, tenant)] += n;
+    }
+    self->creds_granted_tx.fetch_add(n, std::memory_order_relaxed);
+    op->dst = dst;
+    op->kind = K_CRED;
+    op->flags = PTCOMM_CRED_GRANT;
+    op->pool = pool;
+    op->arg = tenant;
+    op->aux = (uint64_t)n;
+    sq_push(self, op);
+    Py_RETURN_NONE;
+}
+
+// cred_take(dst, pool, tenant, n=1) -> bool: spend n credits toward
+// rank `dst` LOCALLY — one mutex-guarded map op, no wire traffic. False
+// = balance exhausted (the remote-admission backpressure signal).
+PyObject *comm_cred_take(PyObject *obj, PyObject *args) {
+    Comm *self = reinterpret_cast<Comm *>(obj);
+    int dst;
+    unsigned int pool, tenant;
+    long long n = 1;
+    if (!PyArg_ParseTuple(args, "iII|L", &dst, &pool, &tenant, &n))
+        return nullptr;
+    if (!check_cred_args(self, dst, n, true)) return nullptr;
+    bool ok = false;
+    {
+        std::lock_guard<std::mutex> lk(*self->cred_mu);
+        auto &m = (*self->cred_avail)[(size_t)dst];
+        auto it = m.find(cred_key(pool, tenant));
+        if (it != m.end() && it->second >= n) {
+            it->second -= n;
+            ok = true;
+        }
+    }
+    if (ok) self->creds_spent.fetch_add(n, std::memory_order_relaxed);
+    return PyBool_FromLong(ok ? 1 : 0);
+}
+
+// cred_return(dst, pool, tenant, n) -> returned: hand up to n unspent
+// credits back to the granting rank (a K_CRED return frame); returns
+// how many were actually held and returned.
+PyObject *comm_cred_return(PyObject *obj, PyObject *args) {
+    Comm *self = reinterpret_cast<Comm *>(obj);
+    int dst;
+    unsigned int pool, tenant;
+    long long n;
+    if (!PyArg_ParseTuple(args, "iIIL", &dst, &pool, &tenant, &n))
+        return nullptr;
+    if (!check_cred_args(self, dst, n, true)) return nullptr;
+    int64_t take = 0;
+    {
+        std::lock_guard<std::mutex> lk(*self->cred_mu);
+        auto &m = (*self->cred_avail)[(size_t)dst];
+        auto it = m.find(cred_key(pool, tenant));
+        if (it != m.end() && it->second > 0) {
+            take = it->second < n ? it->second : n;
+            it->second -= take;
+        }
+    }
+    if (take > 0) {
+        SendOp *op = new (std::nothrow) SendOp();
+        if (op) {
+            op->dst = dst;
+            op->kind = K_CRED;
+            op->flags = PTCOMM_CRED_RETURN;
+            op->pool = pool;
+            op->arg = tenant;
+            op->aux = (uint64_t)take;
+            sq_push(self, op);
+            self->creds_returned_tx.fetch_add(take,
+                                              std::memory_order_relaxed);
+        }
+    }
+    return PyLong_FromLongLong(take);
+}
+
+// cred_consume(src, pool, tenant, n=1) -> consumed: a credited insert
+// ARRIVED from rank `src` — shrink the outstanding ledger by the spent
+// credit (the target-side half of the local-spend contract; floors at
+// 0 so an uncredited or post-reclaim arrival cannot go negative).
+PyObject *comm_cred_consume(PyObject *obj, PyObject *args) {
+    Comm *self = reinterpret_cast<Comm *>(obj);
+    int src;
+    unsigned int pool, tenant;
+    long long n = 1;
+    if (!PyArg_ParseTuple(args, "iII|L", &src, &pool, &tenant, &n))
+        return nullptr;
+    if (!check_cred_args(self, src, n, true)) return nullptr;
+    int64_t took = 0;
+    {
+        std::lock_guard<std::mutex> lk(*self->cred_mu);
+        auto &m = (*self->cred_out)[(size_t)src];
+        auto it = m.find(cred_key(pool, tenant));
+        if (it != m.end() && it->second > 0) {
+            took = it->second < n ? it->second : n;
+            it->second -= took;
+        }
+    }
+    return PyLong_FromLongLong(took);
+}
+
+PyObject *comm_cred_avail(PyObject *obj, PyObject *args) {
+    Comm *self = reinterpret_cast<Comm *>(obj);
+    int dst;
+    unsigned int pool, tenant;
+    if (!PyArg_ParseTuple(args, "iII", &dst, &pool, &tenant))
+        return nullptr;
+    if (!check_cred_args(self, dst, 1, false)) return nullptr;
+    std::lock_guard<std::mutex> lk(*self->cred_mu);
+    auto &m = (*self->cred_avail)[(size_t)dst];
+    auto it = m.find(cred_key(pool, tenant));
+    return PyLong_FromLongLong(it == m.end() ? 0 : it->second);
+}
+
+PyObject *comm_cred_outstanding(PyObject *obj, PyObject *args) {
+    Comm *self = reinterpret_cast<Comm *>(obj);
+    int dst;
+    unsigned int pool, tenant;
+    if (!PyArg_ParseTuple(args, "iII", &dst, &pool, &tenant))
+        return nullptr;
+    if (!check_cred_args(self, dst, 1, false)) return nullptr;
+    std::lock_guard<std::mutex> lk(*self->cred_mu);
+    auto &m = (*self->cred_out)[(size_t)dst];
+    auto it = m.find(cred_key(pool, tenant));
+    return PyLong_FromLongLong(it == m.end() ? 0 : it->second);
+}
+
+// cred_reclaim(rank) -> ([(pool, tenant, outstanding), ...], dropped):
+// peer-death containment. Zeroes BOTH ledgers for `rank`: the per-key
+// outstanding grants are handed back to the caller so it can release
+// the matching scheduler-plane window reservations (no leaked window),
+// and `dropped` is the now-unspendable balance this rank held toward
+// the dead peer. Idempotent: a second call returns empty.
+PyObject *comm_cred_reclaim(PyObject *obj, PyObject *arg) {
+    Comm *self = reinterpret_cast<Comm *>(obj);
+    long rank = PyLong_AsLong(arg);
+    if (rank == -1 && PyErr_Occurred()) return nullptr;
+    if (rank < 0 || rank >= self->nb_ranks || rank == self->my_rank) {
+        PyErr_SetString(PyExc_ValueError, "bad peer rank");
+        return nullptr;
+    }
+    std::vector<std::pair<uint64_t, int64_t>> out;
+    int64_t dropped = 0, reclaimed = 0;
+    {
+        std::lock_guard<std::mutex> lk(*self->cred_mu);
+        for (auto &kv : (*self->cred_out)[(size_t)rank]) {
+            if (kv.second > 0) {
+                out.emplace_back(kv.first, kv.second);
+                reclaimed += kv.second;
+            }
+        }
+        (*self->cred_out)[(size_t)rank].clear();
+        for (auto &kv : (*self->cred_avail)[(size_t)rank])
+            if (kv.second > 0) dropped += kv.second;
+        (*self->cred_avail)[(size_t)rank].clear();
+    }
+    if (reclaimed)
+        self->creds_reclaimed.fetch_add(reclaimed,
+                                        std::memory_order_relaxed);
+    PyObject *lst = PyList_New((Py_ssize_t)out.size());
+    if (!lst) return nullptr;
+    for (size_t i = 0; i < out.size(); i++) {
+        PyObject *t = Py_BuildValue(
+            "(IIL)", (unsigned int)(out[i].first >> 32),
+            (unsigned int)(out[i].first & 0xFFFFFFFFu),
+            (long long)out[i].second);
+        if (!t) { Py_DECREF(lst); return nullptr; }
+        PyList_SET_ITEM(lst, (Py_ssize_t)i, t);
+    }
+    return Py_BuildValue("(NL)", lst, (long long)dropped);
+}
+
 PyObject *comm_stats(PyObject *obj, PyObject *) {
     Comm *self = reinterpret_cast<Comm *>(obj);
     size_t npay, nearly;
@@ -1455,7 +1754,7 @@ PyObject *comm_stats(PyObject *obj, PyObject *) {
 #define C(name) (long long)self->name.load(std::memory_order_relaxed)
     return Py_BuildValue(
         "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,"
-        "s:L,s:L,s:L,s:n,s:n,s:N}",
+        "s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:n,s:n,s:N}",
         "out_pending", C(out_pending),
         "acts_tx", C(acts_tx), "acts_rx", C(acts_rx), "act_frames_tx",
         C(act_frames_tx), "act_frames_rx", C(act_frames_rx), "data_tx",
@@ -1464,7 +1763,14 @@ PyObject *comm_stats(PyObject *obj, PyObject *) {
         "bytes_tx", C(bytes_tx), "bytes_rx", C(bytes_rx), "frame_errors",
         C(frame_errors), "early_parked", C(early_parked), "late_frames",
         C(late_frames), "dropped_sends",
-        C(dropped_sends), "wakeups", C(wakeups), "loops", C(loops),
+        C(dropped_sends),
+        "creds_granted_tx", C(creds_granted_tx), "creds_granted_rx",
+        C(creds_granted_rx), "creds_spent", C(creds_spent),
+        "creds_returned_tx", C(creds_returned_tx), "creds_returned_rx",
+        C(creds_returned_rx), "creds_reclaimed", C(creds_reclaimed),
+        "cred_frames_tx", C(cred_frames_tx), "cred_frames_rx",
+        C(cred_frames_rx),
+        "wakeups", C(wakeups), "loops", C(loops),
         "payloads_pending", (Py_ssize_t)npay, "early_pending",
         (Py_ssize_t)nearly, "broken_peers", bl);
 #undef C
@@ -1539,6 +1845,27 @@ PyMethodDef comm_methods[] = {
      "take_payload(pool, slot) -> (meta, data); consumes the entry"},
     {"payload_ready", comm_payload_ready, METH_VARARGS,
      "payload_ready(pool, slot) -> bool"},
+    {"cred_grant", comm_cred_grant, METH_VARARGS,
+     "cred_grant(dst, pool, tenant, n): grant n admission credits to a "
+     "remote inserter (K_CRED frame; outstanding ledger bumped)"},
+    {"cred_take", comm_cred_take, METH_VARARGS,
+     "cred_take(dst, pool, tenant, n=1) -> bool: spend credits LOCALLY "
+     "(no wire traffic); False = exhausted (backpressure)"},
+    {"cred_return", comm_cred_return, METH_VARARGS,
+     "cred_return(dst, pool, tenant, n) -> returned: hand unspent "
+     "credits back to the granting rank"},
+    {"cred_consume", comm_cred_consume, METH_VARARGS,
+     "cred_consume(src, pool, tenant, n=1) -> consumed: a credited "
+     "insert arrived — shrink src's outstanding ledger (floors at 0)"},
+    {"cred_avail", comm_cred_avail, METH_VARARGS,
+     "cred_avail(dst, pool, tenant) -> spendable balance toward dst"},
+    {"cred_outstanding", comm_cred_outstanding, METH_VARARGS,
+     "cred_outstanding(dst, pool, tenant) -> credits granted to dst and "
+     "not yet returned/reclaimed"},
+    {"cred_reclaim", comm_cred_reclaim, METH_O,
+     "cred_reclaim(rank) -> ([(pool, tenant, n)], dropped): peer-death "
+     "containment — zero both ledgers for rank, hand back per-key "
+     "outstanding grants so window reservations can be released"},
     {"reap", comm_reap, METH_NOARGS,
      "release Py_buffer pins whose rendezvous replies were served"},
     {"pins_pending", comm_pins_pending, METH_NOARGS,
@@ -1598,6 +1925,10 @@ PyMODINIT_FUNC PyInit__ptcomm(void) {
         PyModule_AddIntConstant(m, "EV_COMM_REP", EV_COMM_REP) < 0 ||
         PyModule_AddIntConstant(m, "EV_COMM_FRAME_TX", EV_COMM_FRAME_TX) < 0 ||
         PyModule_AddIntConstant(m, "EV_COMM_FRAME_RX", EV_COMM_FRAME_RX) < 0 ||
+        PyModule_AddIntConstant(m, "EV_FAB_CRED_TX", EV_FAB_CRED_TX) < 0 ||
+        PyModule_AddIntConstant(m, "EV_FAB_CRED_RX", EV_FAB_CRED_RX) < 0 ||
+        PyModule_AddIntConstant(m, "CRED_GRANT", PTCOMM_CRED_GRANT) < 0 ||
+        PyModule_AddIntConstant(m, "CRED_RETURN", PTCOMM_CRED_RETURN) < 0 ||
         PyModule_AddIntConstant(m, "HIST_BUCKETS", pthist::NBUCKETS) < 0 ||
         PyModule_AddIntConstant(m, "SHM_MAGIC", (long)SHM_MAGIC) < 0 ||
         PyModule_AddIntConstant(m, "SHM_DATA_OFF", (long)SHM_DATA_OFF) < 0) {

@@ -62,6 +62,30 @@ typedef struct PtCommSendVtbl {
     void (*send_act)(void *comm, int32_t dst, uint32_t pool, int32_t tid);
 } PtCommSendVtbl;
 
+// ---------------------------------------------------------------- ptfab
+// Credit frames of the cross-rank serving fabric (ISSUE 11). The frame
+// kind K_CRED rides the same wire as ACTS/DATA so admission control and
+// work share one FIFO per link; it is comm-internal (no engine vtable
+// entry — credits gate INSERTION, which happens above the engines), but
+// the flag values are part of the wire contract both ends of a mesh
+// must agree on, so they live in this shared header:
+//
+//   hdr.pool  = comm pool id of the serving taskpool
+//   hdr.arg   = tenant id (crc32 of the tenant name, 0 = the pool itself)
+//   hdr.aux   = credit count (u64, > 0)
+//   hdr.flags = PTCOMM_CRED_GRANT: target -> inserter, adds to the
+//               inserter's locally-spendable balance for (dst,pool,tenant);
+//               PTCOMM_CRED_RETURN: inserter -> target, hands unspent
+//               credits back so the target's outstanding ledger (and with
+//               it the pool's admission headroom) shrinks.
+//
+// Spends are NOT on the wire: an inserter debits its local balance
+// (Comm.cred_take, one mutex-guarded map op) and the spent credit is
+// implicitly consumed at the target by the arriving insert's normal
+// admission accounting — the zero-round-trip hot-path contract.
+#define PTCOMM_CRED_GRANT 0
+#define PTCOMM_CRED_RETURN 1
+
 }  // extern "C"
 
 #endif  // PARSEC_TPU_PTCOMM_IFACE_H

@@ -205,6 +205,47 @@ PyObject *plane_over_window(PyObject *obj, PyObject *arg) {
     return PyBool_FromLong(P(obj)->over_window((int)h) ? 1 : 0);
 }
 
+PyObject *plane_remote_grant(PyObject *obj, PyObject *args) {
+    long h;
+    long long n = 1;
+    if (!PyArg_ParseTuple(args, "l|L", &h, &n)) return nullptr;
+    if (!check_handle(P(obj), h)) return nullptr;
+    P(obj)->remote_grant((int)h, n);
+    Py_RETURN_NONE;
+}
+
+PyObject *plane_remote_release(PyObject *obj, PyObject *args) {
+    long h;
+    long long n = 1;
+    if (!PyArg_ParseTuple(args, "l|L", &h, &n)) return nullptr;
+    if (!check_handle(P(obj), h)) return nullptr;
+    P(obj)->remote_release((int)h, n);
+    Py_RETURN_NONE;
+}
+
+PyObject *plane_remote_granted(PyObject *obj, PyObject *arg) {
+    long h = PyLong_AsLong(arg);
+    if (h == -1 && PyErr_Occurred()) return nullptr;
+    if (!check_handle(P(obj), h)) return nullptr;
+    return PyLong_FromLongLong(P(obj)->remote_granted_of((int)h));
+}
+
+PyObject *plane_headroom(PyObject *obj, PyObject *arg) {
+    long h = PyLong_AsLong(arg);
+    if (h == -1 && PyErr_Occurred()) return nullptr;
+    if (!check_handle(P(obj), h)) return nullptr;
+    return PyLong_FromLongLong(P(obj)->headroom_of((int)h));
+}
+
+PyObject *plane_set_weight(PyObject *obj, PyObject *args) {
+    long h;
+    int w;
+    if (!PyArg_ParseTuple(args, "li", &h, &w)) return nullptr;
+    if (!check_handle(P(obj), h)) return nullptr;
+    P(obj)->set_weight((int)h, (int32_t)w);
+    Py_RETURN_NONE;
+}
+
 PyObject *plane_stall(PyObject *obj, PyObject *arg) {
     long h = PyLong_AsLong(arg);
     if (h == -1 && PyErr_Occurred()) return nullptr;
@@ -269,7 +310,7 @@ PyObject *plane_stats(PyObject *obj, PyObject *) {
     // summing them would make these metrics go BACKWARDS (found by the
     // verify drive: a second wave of pools wiped the first wave's served)
     return Py_BuildValue(
-        "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:i,s:i}",
+        "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:i,s:i}",
         "steals", (long long)steals,
         "steal_visits",
         (long long)pl->steal_visits.load(std::memory_order_relaxed),
@@ -279,6 +320,8 @@ PyObject *plane_stats(PyObject *obj, PyObject *) {
         (long long)pl->served_total.load(std::memory_order_relaxed),
         "admission_stalls",
         (long long)pl->admission_stalls.load(std::memory_order_relaxed),
+        "weight_adjusts",
+        (long long)pl->weight_adjusts.load(std::memory_order_relaxed),
         "queued", (long long)queued,
         "pools_registered",
         (long long)pl->pools_registered.load(std::memory_order_relaxed),
@@ -305,12 +348,15 @@ PyObject *plane_pool_stats(PyObject *obj, PyObject *arg) {
     if (!check_handle(P(obj), h)) return nullptr;
     ptsched::Pool &p = P(obj)->pools[h];
     return Py_BuildValue(
-        "{s:O,s:i,s:i,s:L,s:L,s:L,s:L,s:L,s:L,s:I}",
+        "{s:O,s:i,s:i,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:I}",
         "live", p.live ? Py_True : Py_False,
-        "kind", p.kind, "weight", (int)p.weight,
+        "kind", p.kind,
+        "weight", (int)p.weight.load(std::memory_order_relaxed),
         "window", (long long)p.window,
         "queued", (long long)p.queued.load(std::memory_order_relaxed),
         "inflight", (long long)p.inflight.load(std::memory_order_relaxed),
+        "remote_granted",
+        (long long)p.remote_granted.load(std::memory_order_relaxed),
         "served", (long long)p.served.load(std::memory_order_relaxed),
         "spills", (long long)p.spills.load(std::memory_order_relaxed),
         "stalls", (long long)p.stalls.load(std::memory_order_relaxed),
@@ -379,7 +425,22 @@ PyMethodDef plane_methods[] = {
     {"inflight", plane_inflight, METH_O,
      "admitted-minus-retired tasks of pool h"},
     {"over_window", plane_over_window, METH_O,
-     "True when pool h is past its admission window"},
+     "True when pool h is past its admission window (local inflight + "
+     "remote grants share the budget)"},
+    {"remote_grant", plane_remote_grant, METH_VARARGS,
+     "remote_grant(h, n=1): reserve window room for credits granted to "
+     "remote inserters (ptfab)"},
+    {"remote_release", plane_remote_release, METH_VARARGS,
+     "remote_release(h, n=1): release reserved remote window room "
+     "(arrival/return/reclaim; floors at 0)"},
+    {"remote_granted", plane_remote_granted, METH_O,
+     "window room currently reserved for remote inserters of pool h"},
+    {"headroom", plane_headroom, METH_O,
+     "grantable window room of pool h (window - inflight - "
+     "remote_granted), -1 = unlimited"},
+    {"set_weight", plane_set_weight, METH_VARARGS,
+     "set_weight(h, w): mid-run QoS weight nudge (the ptfab "
+     "reconciliation entry; binds at the next DRR round top-up)"},
     {"stall", plane_stall, METH_O,
      "count one admission stall against pool h"},
     {"queued", plane_queued, METH_O,

@@ -73,7 +73,9 @@
 
 namespace ptsched {
 
-constexpr int ABI = 1;          // bump on any layout/semantics change
+constexpr int ABI = 2;          // bump on any layout/semantics change
+                                // (2: atomic weight + remote windows,
+                                // ISSUE 11)
 
 constexpr int MAX_WORKERS = 64;
 constexpr int MAX_POOLS = 1024;
@@ -117,12 +119,19 @@ struct Pool {
     bool heap = false;             // sticky: set by the first nonzero prio
     bool live = false;
     int kind = KIND_EXT;
-    int32_t weight = 1;
+    // weight is ATOMIC (ISSUE 11): the serving fabric's reconciliation
+    // loop nudges it mid-run (set_weight) while DRR refills read it
+    std::atomic<int32_t> weight{1};
     int64_t window = 0;            // admission window, 0 = unlimited
     uint32_t ext_id = 0;           // caller's pool identity (diagnostics)
     int64_t deficit = 0;           // DRR credits (guarded by arb_mu)
     std::atomic<int64_t> queued{0};    // items in hot queues + overflow
     std::atomic<int64_t> inflight{0};  // admit() - retired()
+    // window room RESERVED for remote inserters (ISSUE 11): credits
+    // granted on the wire and not yet consumed/returned/reclaimed.
+    // over_window charges it alongside inflight, so local and remote
+    // admission share ONE budget per pool
+    std::atomic<int64_t> remote_granted{0};
     std::atomic<int64_t> served{0};    // items popped for execution
     std::atomic<int64_t> spills{0};    // hot-queue overflow -> pool cold
     std::atomic<int64_t> stalls{0};    // admission stalls (python bumps)
@@ -148,6 +157,7 @@ struct Plane {
     std::atomic<int64_t> pools_registered{0};   // lifetime registrations
     std::atomic<int64_t> pools_live{0};
     std::atomic<int64_t> admission_stalls{0};
+    std::atomic<int64_t> weight_adjusts{0};   // set_weight calls (ptfab)
     // plane-LIFETIME accumulators: per-pool counters reset when a freed
     // slot is re-registered, so summing them is non-monotonic — a
     // metrics counter must never go backwards
@@ -197,11 +207,13 @@ struct Plane {
                     p.overflow.clear();
                     p.heap = (policy == POLICY_PRIO);
                     p.kind = kind;
-                    p.weight = weight > 0 ? weight : 1;
+                    p.weight.store(weight > 0 ? weight : 1,
+                                   std::memory_order_relaxed);
                     p.window = window > 0 ? window : 0;
                     p.ext_id = ext_id;
                     p.queued.store(0, std::memory_order_relaxed);
                     p.inflight.store(0, std::memory_order_relaxed);
+                    p.remote_granted.store(0, std::memory_order_relaxed);
                     p.served.store(0, std::memory_order_relaxed);
                     p.spills.store(0, std::memory_order_relaxed);
                     p.stalls.store(0, std::memory_order_relaxed);
@@ -303,11 +315,64 @@ struct Plane {
     inline int64_t inflight_of(int h) {
         return h < 0 ? 0 : pools[h].inflight.load(std::memory_order_relaxed);
     }
+    inline int64_t charge_of(Pool &p) {
+        // total window charge: local in-flight + room reserved for
+        // remote inserters (the ISSUE 11 shared-budget contract)
+        return p.inflight.load(std::memory_order_relaxed) +
+               p.remote_granted.load(std::memory_order_relaxed);
+    }
     inline bool over_window(int h) {
         if (h < 0) return false;
         Pool &p = pools[h];
-        return p.window > 0 &&
-               p.inflight.load(std::memory_order_relaxed) > p.window;
+        return p.window > 0 && charge_of(p) > p.window;
+    }
+
+    // ---------------------------------------------------- remote windows
+    // reserve/release window room for credits granted to remote
+    // inserters (ISSUE 11). The fabric reserves BEFORE a wire grant and
+    // releases as granted work arrives (admit() then carries it as
+    // inflight), as unspent credits return, or at peer-death reclaim —
+    // the reservation can therefore never leak past those three paths.
+    inline void remote_grant(int h, int64_t n) {
+        if (h >= 0)
+            pools[h].remote_granted.fetch_add(n, std::memory_order_relaxed);
+    }
+    inline void remote_release(int h, int64_t n) {
+        if (h < 0) return;
+        // floor at 0: a release racing a reclaim must not go negative
+        // (advisory accounting, same discipline as the DRR deficit)
+        Pool &p = pools[h];
+        int64_t cur = p.remote_granted.load(std::memory_order_relaxed);
+        while (cur > 0) {
+            int64_t next = cur > n ? cur - n : 0;
+            if (p.remote_granted.compare_exchange_weak(
+                    cur, next, std::memory_order_relaxed,
+                    std::memory_order_relaxed))
+                break;
+        }
+    }
+    inline int64_t remote_granted_of(int h) {
+        return h < 0 ? 0
+                     : pools[h].remote_granted.load(
+                           std::memory_order_relaxed);
+    }
+    // window room still grantable: window - inflight - remote_granted,
+    // or -1 for an unlimited pool (window == 0)
+    inline int64_t headroom_of(int h) {
+        if (h < 0) return 0;
+        Pool &p = pools[h];
+        if (p.window <= 0) return -1;
+        int64_t room = p.window - charge_of(p);
+        return room > 0 ? room : 0;
+    }
+
+    // mid-run QoS nudge (ISSUE 11): the reconciliation loop's capsule
+    // entry. Weight binds at the NEXT DRR round top-up; the in-flight
+    // deficit is untouched (advisory fairness state, like register's)
+    void set_weight(int h, int32_t w) {
+        if (h < 0 || h >= MAX_POOLS) return;
+        pools[h].weight.store(w > 0 ? w : 1, std::memory_order_relaxed);
+        weight_adjusts.fetch_add(1, std::memory_order_relaxed);
     }
 
     // ----------------------------------------------------------------- push
@@ -515,7 +580,8 @@ struct Plane {
                 continue;
             }
             cursor[k] = (i + 1) % MAX_POOLS;
-            p.deficit += (int64_t)p.weight * quantum;
+            p.deficit += (int64_t)p.weight.load(std::memory_order_relaxed) *
+                         quantum;
             if (quantum_out) *quantum_out = p.deficit;
             return i;
         }
@@ -612,7 +678,9 @@ struct Plane {
                 continue;
             }
             if (wdrr && p.deficit <= 0)      // round top-up, once per visit
-                p.deficit += (int64_t)p.weight * quantum;
+                p.deficit +=
+                    (int64_t)p.weight.load(std::memory_order_relaxed) *
+                    quantum;
             int64_t credit = wdrr ? p.deficit : quantum;
             int want = (int)((int64_t)(cap - n) < credit
                                  ? (int64_t)(cap - n) : credit);

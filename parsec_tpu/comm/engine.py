@@ -24,7 +24,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-MAX_REGISTERED_TAGS = 12  # ref: PARSEC_MAX_REGISTERED_TAGS
+MAX_REGISTERED_TAGS = 16  # ref: PARSEC_MAX_REGISTERED_TAGS (12 there;
+                          # widened for the runtime-internal tags below)
 
 # predefined tags (ref: parsec_comm_engine.h:29-40 enumeration)
 TAG_INTERNAL_GET = 0
@@ -36,6 +37,10 @@ TAG_PTCOMM_BOOT = 8       # native comm lane bootstrap (comm/native.py)
 TAG_CLOCKSYNC = 9         # rank-0 clock-offset ping-pong (remote_dep.py)
 TAG_CNT_AGG = 10          # cross-rank counter aggregation at fini
 TAG_DTD_AUDIT = 11        # DTD replay-consistency auditor exchange
+TAG_PTFAB = 12            # serving-fabric control plane (serving/):
+                          # gateway-routed inserts + reconciliation
+                          # weight nudges; admission credits themselves
+                          # ride the NATIVE wire (ptcomm K_CRED)
 
 # capability flags (ref: parsec_comm_engine capabilities)
 CAP_ONESIDED = 0x1

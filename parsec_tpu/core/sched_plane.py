@@ -46,6 +46,13 @@ mca.register("sched_pool_weight", 1,
              "(DRR share: a weight-2 pool is served ~2x the tasks of a "
              "weight-1 pool under contention); per-pool override via "
              "tp.qos_weight", type=int)
+mca.register("sched_quantum", 256,
+             "DRR credit unit of the scheduler plane (tasks per weight "
+             "point per round). Weights only bind on pools whose backlog "
+             "exceeds weight*quantum, so serving meshes with tight "
+             "admission windows (ptfab) want a SMALL quantum — the "
+             "fairness/batching tradeoff documented in docs/serving.md",
+             type=int)
 mca.register("sched_admission_window", 0,
              "Admission soft limit per taskpool (in-flight inserted-but-"
              "not-completed tasks) on the scheduler plane: past it, "
@@ -99,7 +106,8 @@ class SchedPlane:
         self.policy = policy_name
         self.plane = mod.Plane(
             nworkers=nworkers,
-            policy=getattr(mod, f"POLICY_{policy_name.upper()}"))
+            policy=getattr(mod, f"POLICY_{policy_name.upper()}"),
+            quantum=max(1, int(mca.get("sched_quantum", 256))))
         #: the capsule the engines bind through (owns a plane ref)
         self.capsule = self.plane.plane_capsule()
         self.KIND_PTEXEC = mod.KIND_PTEXEC
@@ -202,6 +210,31 @@ class SchedPlane:
     def count_stall(self, h: int) -> None:
         self.plane.stall(h)
         SCHED_STATS["admission_stalls"] += 1
+
+    # ------------------------------------------------- serving fabric
+    # (ptfab, ISSUE 11): remote-window reservations + the mid-run QoS
+    # weight nudge the reconciliation loop applies. All thin passthroughs
+    # to the native plane — the fabric holds handles, not pool names.
+    def headroom(self, h: Optional[int]) -> int:
+        """Grantable window room of pool h (-1 = unlimited)."""
+        if h is None or h < 0:
+            return 0
+        return self.plane.headroom(h)
+
+    def remote_grant(self, h: int, n: int = 1) -> None:
+        self.plane.remote_grant(h, n)
+
+    def remote_release(self, h: int, n: int = 1) -> None:
+        self.plane.remote_release(h, n)
+
+    def set_weight(self, h: int, weight: int) -> None:
+        self.plane.set_weight(h, max(1, int(weight)))
+
+    def admit(self, h: int, n: int = 1) -> None:
+        self.plane.admit(h, n)
+
+    def retired(self, h: int, n: int = 1) -> None:
+        self.plane.retired(h, n)
 
     # ------------------------------------------------------------- stats
     def stats(self) -> Dict[str, int]:

@@ -1,0 +1,173 @@
+"""Mesh-wide QoS share reconciliation (ISSUE 11, tentpole part b).
+
+Per-rank weighted DRR (PR 9) guarantees shares WITHIN a rank; nothing
+guaranteed them ACROSS ranks — a tenant draining mostly on rank 3 could
+take 3x its global share while staying exactly on-weight everywhere.
+This module closes the loop without a global lock anywhere near the hot
+path:
+
+* rank 0 runs a :class:`ShareReconciler` — a slow control loop (default
+  4 Hz) that scrapes every rank's ``/metrics`` endpoint (the PR 8
+  observability plane) for the ``ptfab.served.<tenant>`` counters the
+  fabric registers per served tenant;
+* each round it computes the MEASURED global share of every tenant over
+  the last window (served deltas summed across ranks), compares against
+  the target share from the global weights, and nudges a per-tenant
+  weight multiplier: ``m *= (target / measured) ** gain`` (clamped — a
+  cold tenant must not explode its weight);
+* the nudged weights quantize to integer DRR weights (scale 16) and ride
+  one ``TAG_PTFAB {"k": "weights"}`` AM to every rank, where the fabric
+  applies them through the new ``Plane.set_weight`` capsule entry —
+  weights bind at the next DRR round top-up, so convergence is smooth,
+  not steppy.
+
+Convergence caveats (documented in docs/serving.md): shares only bind
+while every tenant keeps every rank's drain backlogged (DRR serves an
+idle tenant at its arrival rate, as within one rank), and the loop
+measures SERVED tasks — heterogeneous task costs reconcile task-shares,
+not cpu-shares.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils import output
+from .fabric import FAB_STATS, ServingFabric
+
+
+class ShareReconciler:
+    """Rank-0 control loop converging measured per-tenant global shares
+    to the global QoS weights by nudging per-rank local DRR weights."""
+
+    #: integer-weight quantization: multiplier 1.0 -> DRR weight
+    #: base_weight * scale stays exact for the common small weights.
+    #: CAVEAT (docs/serving.md): a pool's weight binds only while its
+    #: backlog exceeds weight * plane-quantum, so scale * quantum should
+    #: stay well under the admission windows — serving meshes pair a
+    #: small --mca sched_quantum with a small scale.
+    SCALE = 16
+
+    #: a round whose total served delta is below this carries no usable
+    #: share signal (a 0-delta tenant would read as "starved" and get a
+    #: runaway boost): skip the nudge, keep the baseline
+    MIN_WINDOW_TASKS = 32
+
+    def __init__(self, fabric: ServingFabric, endpoints: List[str],
+                 weights: Dict[str, float], *, period: float = 0.25,
+                 gain: float = 0.6, max_mult: float = 16.0,
+                 scale: Optional[int] = None) -> None:
+        self.fabric = fabric
+        self.endpoints = list(endpoints)   # rank-indexed /metrics addrs
+        self.weights = dict(weights)       # tenant -> global weight
+        self.period = period
+        self.gain = gain
+        self.max_mult = max_mult
+        self.scale = scale if scale is not None else self.SCALE
+        self._mult = {t: 1.0 for t in weights}       # nudged multiplier
+        self._last: Optional[Dict[str, int]] = None  # served at last round
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.rounds = 0
+        self.last_err_pct: Optional[float] = None
+
+    # ------------------------------------------------------------ scraping
+    def _scrape(self) -> Optional[Dict[str, int]]:
+        """Global served-per-tenant: the ptfab.served.* counters summed
+        over every rank's /metrics. ANY failed endpoint voids the whole
+        round (None): a partial sum would read a tenant served mostly on
+        the missing rank as STARVED and runaway-boost its weight — the
+        loop is advisory and must mis-steer on no round."""
+        from ..tools.metrics_server import fetch
+        served = {t: 0 for t in self.weights}
+        for ep in self.endpoints:
+            try:
+                counters = fetch(ep)["counters"]
+            except Exception:  # noqa: BLE001 — scrape again next round
+                return None
+            for t in served:
+                served[t] += int(counters.get(f"ptfab.served.{t}", 0) or 0)
+        return served
+
+    # ------------------------------------------------------------- rounds
+    def step(self) -> Optional[float]:
+        """One reconciliation round; returns the max share error (pct)
+        over the window, or None when the window carried no service."""
+        served = self._scrape()
+        if served is None:
+            return None           # _last unchanged: cumulative counters
+                                  # make the next delta span both rounds
+        last, self._last = self._last, served
+        if last is None:
+            return None
+        delta = {t: max(0, served[t] - last.get(t, 0)) for t in served}
+        total = sum(delta.values())
+        tot_w = sum(self.weights.values())
+        if total < self.MIN_WINDOW_TASKS or tot_w <= 0:
+            return None
+        err_max = 0.0
+        new_w: Dict[str, int] = {}
+        for t, w in self.weights.items():
+            target = w / tot_w
+            measured = delta[t] / total
+            if measured > 0:
+                err = abs(measured - target) / target * 100.0
+                err_max = max(err_max, err)
+                nudge = (target / measured) ** self.gain
+                # clamp the per-round nudge AND the cumulative multiplier
+                nudge = min(2.0, max(0.5, nudge))
+                self._mult[t] = min(self.max_mult,
+                                    max(1.0 / self.max_mult,
+                                        self._mult[t] * nudge))
+            else:
+                # a starved tenant: open its weight decisively (measured
+                # share 0 has no finite ratio)
+                err_max = max(err_max, 100.0)
+                self._mult[t] = min(self.max_mult, self._mult[t] * 2.0)
+            new_w[t] = max(1, int(round(w * self._mult[t] * self.scale)))
+        self.rounds += 1
+        self.last_err_pct = round(err_max, 1)
+        FAB_STATS["reconcile_rounds"] += 1
+        FAB_STATS["share_err_pct"] = self.last_err_pct
+        self._broadcast(new_w, self.last_err_pct)
+        return err_max
+
+    def _broadcast(self, weights: Dict[str, int], err_pct: float) -> None:
+        fab = self.fabric
+        # apply locally first (rank 0 serves too), then AM the peers
+        for t, w in weights.items():
+            fab.set_weight(t, w)
+        if fab.rde is None:
+            return
+        from ..comm.engine import TAG_PTFAB
+        hdr = {"k": "weights", "w": weights, "err": err_pct}
+        for r in range(fab.nb_ranks):
+            if r == fab.my_rank or r in fab._dead:
+                continue
+            try:
+                fab.rde.ce.send_am(TAG_PTFAB, r, hdr, None)
+            except Exception:  # noqa: BLE001 — a dying peer reconciles 0x
+                pass
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "ShareReconciler":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="ptfab-reconcile")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — advisory loop
+                output.debug_verbose(1, "ptfab", f"reconcile round: {e}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -106,6 +106,7 @@ def install_native_counters() -> None:
     from ..device import native as _dnative
     from ..dsl import dtd as _dtd
     from ..dsl.ptg import compiler as _ptg
+    from ..serving import fabric as _fab
     from . import native_trace as _nt
     from .hist import install_hist_counters
 
@@ -116,6 +117,7 @@ def install_native_counters() -> None:
                           (_dtd.PTDTD_STATS, "ptdtd"),
                           (_cnative.PTCOMM_STATS, "ptcomm"),
                           (_dnative.PTDEV_STATS, "ptdev"),
+                          (_fab.FAB_STATS, "ptfab"),
                           (_sp.SCHED_STATS, "sched")):
         for key in stats:
             counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
@@ -137,6 +139,12 @@ def install_native_counters() -> None:
     for key in _sp.PLANE_COUNTER_KEYS:
         counters.register(f"sched.{key}",
                           sampler=_sp.plane_counter_sampler(key))
+    # the serving fabric's wire counters (credit grants/spends/reclaims
+    # summed across live fabrics) — ISSUE 11's "credit flow shows up on
+    # /metrics"; ptfab.served.<tenant> registers per served tenant
+    for name, ckey in _fab.FAB_WIRE_KEYS.items():
+        counters.register(f"ptfab.{name}",
+                          sampler=_fab.fab_wire_sampler(ckey))
     counters.register(TRACE_EVENTS_DROPPED, sampler=_nt.total_dropped)
     counters.register(TRACE_EVENTS_NATIVE, sampler=_nt.total_landed)
     counters.register(PTEXEC_SLOTS_RETIRED)   # accumulator: lane finalize adds

@@ -67,7 +67,12 @@ NATIVE_KEYWORDS: Dict[str, Dict[int, str]] = {
                # of one K_ACTS frame; merge_traces pairs frame_tx on the
                # sender with frame_rx on the receiver into Perfetto flow
                # arrows, one causal edge per cross-rank activation frame
-               7: "ptcomm::frame_tx", 8: "ptcomm::frame_rx"},
+               7: "ptcomm::frame_tx", 8: "ptcomm::frame_rx",
+               # serving-fabric credit flow (ISSUE 11): one POINT per
+               # K_CRED frame each way, id = credit count (returns
+               # negative) — admission-control traffic pairs with the
+               # ACT/DATA frames it gates in the merged timeline
+               9: "ptfab::cred_tx", 10: "ptfab::cred_rx"},
     # the device lane's manager-thread events (native/src/ptdev.cpp):
     # dispatch batches as intervals, per-task retirements as points —
     # device occupancy/overlap in the same Perfetto view as the engines

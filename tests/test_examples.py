@@ -17,7 +17,7 @@ from parsec_tpu.comm.xhost import XHostTransfer
 from parsec_tpu.parallel.multihost import cpu_collectives_available
 
 EXAMPLES = [f"ex0{i}" for i in range(9)] + ["ex10", "ex11", "ex12", "ex13",
-                                            "ex14", "ex15", "ex16"]
+                                            "ex14", "ex15", "ex16", "ex17"]
 EX_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                       "examples")
 

@@ -277,28 +277,51 @@ def test_context_multi_pool_concurrent_chains():
 
 
 def test_skewed_pools_keep_workers_busy():
-    """Satellite regression: with two pools of skewed sizes, the tiny
-    pool draining must not park workers while the big pool still holds
-    queued work — both streams keep executing (the per-pool consult in
-    the starvation backoff + the DRR lane pick)."""
-    ctx = pt.Context(nb_cores=2)
-    if ctx.sched_plane is None:
-        ctx.fini()
-        pytest.skip("scheduler plane unavailable on this context")
+    """Satellite regression (deflaked, ISSUE 11): with two pools of
+    skewed sizes, the tiny pool draining must not park workers while
+    the big pool still holds queued work.
+
+    The INVARIANTS assert on PLANE COUNTERS each attempt — both pools
+    registered and retired, the big pool's tasks all served THROUGH the
+    plane, every task completed — which no host load can flake. The
+    per-stream busy-balance observation (each worker executed > 0) is
+    wall-clock-sensitive: on a loaded 2-core host the OS can deschedule
+    one worker for the entire ~1s run, which is starvation by the OS,
+    not by the plane. That observation therefore gets a bounded
+    retry/soak: a plane-level starvation bug reproduces on every
+    attempt; an OS scheduling flap does not survive three."""
     prog = _chain_prog()
-    small = prog.instantiate(ctx, globals={"NT": 4, "DEPTH": 4},
-                             collections={}, name="small")
-    big = prog.instantiate(ctx, globals={"NT": 512, "DEPTH": 64},
-                           collections={}, name="big")
-    ctx.add_taskpool(small)
-    ctx.add_taskpool(big)
-    ctx.wait(timeout=120)
-    total = sum(s.nb_executed for s in ctx.streams)
-    assert total >= 512 * 64 + 4 * 4 + 2
-    # both workers participated (no one parked against a non-empty plane)
-    busy = [s.nb_executed for s in ctx.streams]
-    assert all(b > 0 for b in busy), busy
-    ctx.fini()
+    busy_attempts = []
+    for _attempt in range(3):
+        ctx = pt.Context(nb_cores=2)
+        plane = ctx.sched_plane
+        if plane is None:
+            ctx.fini()
+            pytest.skip("scheduler plane unavailable on this context")
+        before = plane.stats()
+        small = prog.instantiate(ctx, globals={"NT": 4, "DEPTH": 4},
+                                 collections={}, name="small")
+        big = prog.instantiate(ctx, globals={"NT": 512, "DEPTH": 64},
+                               collections={}, name="big")
+        ctx.add_taskpool(small)
+        ctx.add_taskpool(big)
+        ctx.wait(timeout=120)
+        after = plane.stats()
+        # -- counter invariants: hold on EVERY attempt
+        assert after["pools_registered"] - before["pools_registered"] == 2
+        assert after["pools_live"] == 0       # both retired at finalize
+        # the big pool queues while the small one drains, so its tasks
+        # ride the plane (small slack: items the pre-bind window ran)
+        assert after["served"] - before["served"] >= 512 * 64
+        total = sum(s.nb_executed for s in ctx.streams)
+        assert total >= 512 * 64 + 4 * 4 + 2
+        busy = [s.nb_executed for s in ctx.streams]
+        ctx.fini()
+        if all(b > 0 for b in busy):
+            return
+        busy_attempts.append(busy)
+    assert False, ("a worker executed nothing on every attempt "
+                   f"(plane starvation, not an OS flap): {busy_attempts}")
 
 
 # -------------------------------------------------------- ptdtd integration
