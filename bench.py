@@ -44,6 +44,33 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+#: honest-artifact tagging, ONE home (ISSUE 12 satellite): every
+#: fusion/capture key measured on XLA-CPU carries the same caveat — the
+#: CPU backend has no asynchronous device, every dispatch runs
+#: synchronously, so whole-program modes (captured DAGs, fused regions)
+#: structurally beat per-task dispatch there. The RATIO keys are the
+#: tracked regression signals; absolute GFLOP/s are not chip numbers.
+CPU_ARTIFACT_NOTE = (
+    "XLA-CPU measurement artifact: the per-dispatch vs whole-program "
+    "trade inverts vs real accelerators (no async device, so fused/"
+    "captured legs pay no dispatch latency to amortize, while the CPU "
+    "whole-program thunk schedule runs single-threaded); the RATIO "
+    "keys are the tracked regression signals, absolutes are not chip "
+    "numbers")
+
+
+def tag_cpu_artifact(results: dict, *keys: str) -> None:
+    """Record that ``keys`` were measured on the XLA-CPU proxy host.
+    Readers check ``cpu_artifact_keys`` instead of per-leg ad-hoc
+    booleans (the legacy ``gemm_cpu_artifact`` /
+    ``potrf_captured_cpu_artifact`` flags stay for r1-r11 continuity)."""
+    ks = results.setdefault("cpu_artifact_keys", [])
+    for k in keys:
+        if k in results and k not in ks:
+            ks.append(k)
+    results["cpu_artifact_note"] = CPU_ARTIFACT_NOTE
+
+
 def detect_chip(device_kind: str) -> tuple:
     """(generation, bf16 peak TFLOP/s) from the device kind string and the
     relay's env; ("", None) when unrecognized."""
@@ -435,6 +462,8 @@ def main() -> None:
     if on_tpu and peak_tflops:
         results["pct_of_peak_bf16"] = round(
             cap_gflops / (peak_tflops * 1e3) * 100, 1)
+    else:
+        tag_cpu_artifact(results, "gemm_captured_gflops")
     persist("after captured GEMM")
 
     def run_dags(n_dags: int) -> float:
@@ -1175,12 +1204,12 @@ def main() -> None:
                 if k in dl:
                     results[k] = dl[k]
             if dl.get("gemm_cpu_artifact"):
-                results["device_lane_note"] = (
-                    "over_cpu device: XLA-CPU has no async device, so "
-                    "every dispatch runs synchronously and the captured "
-                    "single executable structurally wins; the ratio is "
-                    "the tracked signal, overlap_pct shows the push/exec "
-                    "pipeline engaging")
+                # unified honest-artifact tagging (ISSUE 12 satellite):
+                # the ratio stays the tracked signal, overlap_pct shows
+                # the push/exec pipeline engaging
+                tag_cpu_artifact(results, "gemm_gflops_sched_native",
+                                 "gemm_gflops_captured",
+                                 "gemm_sched_native_vs_captured")
             log(f"device lane GEMM: sched-native "
                 f"{dl.get('gemm_gflops_sched_native')} vs captured "
                 f"{dl.get('gemm_gflops_captured')} GFLOP/s "
@@ -1211,6 +1240,42 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — degrade, keep all other keys
         log(f"zone bench leg failed: {e}")
     persist("after device lane legs")
+
+    # ---- region fusion + warm pools (ISSUE 12): capturable subgraphs --
+    # collapse into fused super-tasks (one jitted program per region) and
+    # compiled region executables persist across pool instantiations —
+    # `pool_instantiation_ms_{cold,warm}` is the serving warm-pool
+    # contract (warm < 0.5x cold), `fusion_speedup_ratio` the on/off
+    # wall ratio on a mixed GEMM+seam DAG. Subprocess so the leg's mca
+    # toggles never leak; degrade-and-continue per key.
+    try:
+        fp = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "fusion_bench.py")],
+            capture_output=True, text=True, timeout=900,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert fp.returncode == 0, fp.stderr[-500:]
+        fl = json.loads(fp.stdout.strip().splitlines()[-1])
+        if fl.get("fusion_engaged"):
+            for k in ("pool_instantiation_ms_cold",
+                      "pool_instantiation_ms_warm",
+                      "pool_instantiation_warm_vs_cold",
+                      "fusion_on_ms", "fusion_off_ms",
+                      "fusion_speedup_ratio"):
+                if k in fl:
+                    results[k] = fl[k]
+            tag_cpu_artifact(results, "fusion_speedup_ratio",
+                             "fusion_on_ms", "fusion_off_ms")
+            log(f"region fusion: cold {fl.get('pool_instantiation_ms_cold')}"
+                f"ms vs warm {fl.get('pool_instantiation_ms_warm')}ms "
+                f"instantiation; on/off speedup "
+                f"{fl.get('fusion_speedup_ratio')}x")
+        else:
+            log(f"fusion leg: did not engage; keys withheld "
+                f"({fl.get('fusion_note', '')[:200]})")
+    except Exception as e:  # noqa: BLE001 — degrade, keep all other keys
+        log(f"fusion leg failed: {e}")
+    persist("after fusion legs")
 
     # per-dispatch protocol cost of this chip path (diagnostic: on the
     # tunneled chip this is ~1000x a local PJRT dispatch and bounds any
@@ -1313,6 +1378,8 @@ def main() -> None:
 
     got = run_leg("potrf-captured", 900)
     results.update(got)
+    if got.get("potrf_captured_cpu_artifact"):
+        tag_cpu_artifact(results, "potrf_captured_gflops")
     if "potrf_captured_gflops" in got:
         results["potrf_gflops"] = round(
             max(potrf_sched_gflops, got["potrf_captured_gflops"]), 1)

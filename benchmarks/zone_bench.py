@@ -227,9 +227,14 @@ def ci_gate() -> None:
     assert lane, "no device lane created"
     gs = tp._ptexec_state["graph"].dev_stats()
     nt = (n // ts) ** 3
+    # dev_tx/dev_done are ORIGINAL-task denominated (a fused region node
+    # surfaces once but counts its whole region, ISSUE 12); the Lane's
+    # own queue counters are per ITEM — regions + unfused device tasks
+    rs = tp._ptexec_state["graph"].region_stats()
+    n_items = rs["fused_regions"] + (nt - rs["fused_tasks"])
     assert gs["dev_tx"] == gs["dev_done"] == nt and gs["dev_bad"] == 0, gs
     ls = lane.clane.stats()
-    assert ls["retired"] >= nt and ls["cb_errors"] == 0, ls
+    assert ls["retired"] >= n_items and ls["cb_errors"] == 0, ls
     assert lane.failed() is None
     # zero coherency violations. A valid table entry may legally trail
     # data.version (a SHARED replica goes stale when the HOST takes the
@@ -259,6 +264,8 @@ def ci_gate() -> None:
     mca.params.unset("device_tpu_over_cpu")
     print(json.dumps({"device_lane_gate": "OK", "tasks": nt,
                       "ptexec": delta, "ptdev": ddelta,
+                      "regions": {k: rs[k] for k in
+                                  ("fused_regions", "fused_tasks")},
                       "lane": {k: ls[k] for k in
                                ("retired", "overlap_hits",
                                 "dispatch_batches")}}))

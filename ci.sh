@@ -124,6 +124,15 @@ echo "== native device lane engagement smoke (over_cpu) =="
 # zero coherency violations in the C residency table, bit-correct GEMM
 JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/zone_bench.py --ci-gate
 
+echo "== region fusion + warm-pool engagement smoke =="
+# ISSUE 12: a mixed fusable/un-fusable PTG DAG must run with >= 1 fused
+# region (capturable k-chains collapse into ONE jitted super-task each),
+# ZERO pools_fallback, every seam task scheduled normally, and a
+# bit-exact result; a SECOND instantiation of the same program must hit
+# the persistent executable cache (capture.cache_hits >= 1) with a
+# measurably cheaper (warm) instantiation. Engagement, not throughput.
+JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/fusion_bench.py --ci-gate
+
 echo "== cross-rank serving fabric engagement smoke (ptfab, 2 ranks) =="
 # ISSUE 11: credit grants/spends must be nonzero ON THE WIRE with zero
 # frame errors (spends local — frames don't scale with spends), remote

@@ -65,6 +65,9 @@ namespace {
 // utils/native_trace.py; see ptrace_ring.h for the ring contract)
 constexpr uint32_t EV_TASK = 1;      // one interval per task's retire step
 constexpr uint32_t EV_DISPATCH = 2;  // one interval per batched body dispatch
+constexpr uint32_t EV_REGION = 3;    // one interval per fused-region body
+                                     // (recorded via trace_mark from the
+                                     // region dispatch wrapper, ISSUE 12)
 
 // latency histogram slots (pthist.h; names mirrored in utils/hist.py)
 constexpr int H_EXEC = 0;        // per-task execute latency (batch-amortized)
@@ -144,6 +147,16 @@ struct Graph {
     std::atomic<int64_t> dev_tx;      // tasks surfaced onto the lane
     std::atomic<int64_t> dev_done;    // tasks retired by the lane
     std::atomic<int64_t> dev_bad;     // out-of-range/unmasked retire ids
+    // region fusion (region_bind, ISSUE 12): a fused super-task node
+    // stands for `weight[i]` original tasks — the CSR already carries
+    // the union of the region's external in/out edges (built by the
+    // compiler's fusion pass), so the release walk crosses the seam
+    // correctly by construction; the weights make the task ACCOUNTING
+    // cross it too: completed/pending/done and run()'s return value
+    // count original tasks, not fused nodes.
+    std::vector<int32_t> *weight;     // per node; empty = all 1
+    bool weighted;
+    int64_t w_total;                  // sum(weight) — the done() target
     // scheduler plane binding (sched_bind, ISSUE 9): when set, the ready
     // structure lives in the shared multi-pool plane (pool `spool`) — N
     // concurrent lane graphs then share the workers by DRR weight instead
@@ -201,7 +214,11 @@ bool slots_pending_locked(Graph *g, int32_t t) {
 void push_ready_locked(Graph *g, int32_t s) {
     if (g->dev_bound && (*g->dev_mask)[(size_t)s]) {
         g->dsend.submit(g->dsend.dev, g->dev_pool, s);
-        g->dev_tx.fetch_add(1, std::memory_order_relaxed);
+        // dev_tx/dev_done stay ORIGINAL-task denominated: a fused
+        // region node surfaces once but counts its whole region
+        g->dev_tx.fetch_add(
+            g->weighted ? (*g->weight)[(size_t)s] : 1,
+            std::memory_order_relaxed);
         return;
     }
     if (g->comm_bound && slots_pending_locked(g, s)) {
@@ -246,7 +263,7 @@ int64_t dev_sweep_ready_locked(Graph *g) {
         int32_t s = rd[i];
         if (dmask[s]) {
             g->dsend.submit(g->dsend.dev, g->dev_pool, s);
-            sent++;
+            sent += g->weighted ? (*g->weight)[(size_t)s] : 1;
         } else {
             rd[w++] = s;
         }
@@ -362,6 +379,9 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     new (&self->dev_tx) std::atomic<int64_t>(0);
     new (&self->dev_done) std::atomic<int64_t>(0);
     new (&self->dev_bad) std::atomic<int64_t>(0);
+    self->weight = new (std::nothrow) std::vector<int32_t>();
+    self->weighted = false;
+    self->w_total = 0;
     self->splane = nullptr;
     self->spool = -1;
     self->sched_cap = nullptr;
@@ -369,7 +389,7 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
         !self->ready || !self->mu || !self->prio || !self->in_off ||
         !self->in_slots || !self->slot_uses || !self->retired ||
         !self->owners || !self->rdv_pending || !self->parked ||
-        !self->dev_mask || !self->dev_ret) {
+        !self->dev_mask || !self->dev_ret || !self->weight) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -541,6 +561,7 @@ void graph_dealloc(PyObject *obj) {
     delete self->parked;
     delete self->dev_mask;
     delete self->dev_ret;
+    delete self->weight;
     delete[] self->counts;
     delete[] self->slot_cnt;
     delete[] self->ready_stamp;
@@ -787,7 +808,8 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                         // ready structure — still GIL-free, never blocks
                         self->dsend.submit(self->dsend.dev, self->dev_pool,
                                            s);
-                        dsent++;
+                        dsent += self->weighted
+                                     ? (*self->weight)[(size_t)s] : 1;
                     } else {
                         fresh.push_back(s);
                     }
@@ -819,13 +841,21 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
                     self->ready_stamp[s].store(now,
                                                std::memory_order_relaxed);
         }
+        // weighted accounting (region fusion): a fused node retires as
+        // `weight` original tasks — completed/mine stay task-denominated
+        int64_t batch_w = (int64_t)local.size();
+        if (self->weighted) {
+            batch_w = 0;
+            const int32_t *wts = self->weight->data();
+            for (int32_t t : local) batch_w += wts[t];
+        }
         // plane-bound graphs push releases AFTER the bookkeeping lock
         // drops (the plane has its own locks; rdv-gated distributed data
         // pools keep the per-item mu-held path, which is plane-aware)
         const bool plane_batch = spl && !(bound && !self->in_off->empty());
         {
             std::lock_guard<std::mutex> lk(*self->mu);
-            self->completed += (int64_t)local.size();
+            self->completed += batch_w;
             self->running--;
             if (!fresh.empty() && !plane_batch) {
                 if (bound && !self->in_off->empty()) {
@@ -857,17 +887,24 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             // dispatch + release sweep cost divided across the batch,
             // bumped once with the batch count — two clock reads and
             // three atomics per ~256 tasks keeps the armed overhead
-            // inside the <2% contract
-            int64_t per = (ptrace_ring::now_ns() - h_t0) /
-                          (int64_t)local.size();
-            hs->h[H_EXEC].add(per, local.size());
+            // inside the <2% contract. batch_w keeps the denominator
+            // ORIGINAL-task denominated on fused pools, like every
+            // other counter in this sweep
+            int64_t per = (ptrace_ring::now_ns() - h_t0) / batch_w;
+            hs->h[H_EXEC].add(per, (uint64_t)batch_w);
         }
-        mine += (int64_t)local.size();
+        mine += batch_w;
         local.clear();
         if (budget > 0 && mine >= budget) break;
     }
     if (ts) PyEval_RestoreThread(ts);
     return PyLong_FromLongLong(mine);
+}
+
+// the completion target: original-task denominated once regions are
+// bound (w_total = sum of node weights), node count otherwise
+inline int64_t done_target(const Graph *g) {
+    return g->weighted ? g->w_total : g->n_local;
 }
 
 PyObject *graph_done(PyObject *obj, PyObject *) {
@@ -876,7 +913,7 @@ PyObject *graph_done(PyObject *obj, PyObject *) {
     bool ready_empty =
         self->ready->empty() &&
         (!self->splane || self->splane->queued_of(self->spool) == 0);
-    if (!self->error && self->completed == self->n_local &&
+    if (!self->error && self->completed == done_target(self) &&
         ready_empty && self->running == 0)
         Py_RETURN_TRUE;
     Py_RETURN_FALSE;
@@ -903,7 +940,7 @@ PyObject *graph_idle(PyObject *obj, PyObject *) {
 PyObject *graph_pending(PyObject *obj, PyObject *) {
     Graph *self = reinterpret_cast<Graph *>(obj);
     std::lock_guard<std::mutex> lk(*self->mu);
-    return PyLong_FromLongLong(self->n_local - self->completed);
+    return PyLong_FromLongLong(done_target(self) - self->completed);
 }
 
 // ------------------------------------------------------- comm lane binding
@@ -1019,6 +1056,12 @@ PyObject *graph_comm_bind(PyObject *obj, PyObject *args) {
                             "comm_bind() on a graph already running");
             return nullptr;
         }
+        if (self->weighted) {
+            PyErr_SetString(PyExc_RuntimeError,
+                            "comm_bind() on a region-fused graph (fusion "
+                            "is single-rank)");
+            return nullptr;
+        }
     }
     std::vector<int32_t> owners;
     if (!parse_i32_list(owners_o, owners, "owners: sequence of ints"))
@@ -1102,7 +1145,7 @@ void graph_dev_retire_c(void *obj, int32_t t) {
     }
     {
         std::lock_guard<std::mutex> lk(*self->mu);
-        self->completed++;
+        self->completed += self->weighted ? (*self->weight)[(size_t)t] : 1;
         // push_ready_locked routes each successor: device-bodied back to
         // the lane, plane-bound to the plane, the rest to the vector
         for (int32_t s : fresh) push_ready_locked(self, s);
@@ -1112,12 +1155,20 @@ void graph_dev_retire_c(void *obj, int32_t t) {
             self->nb_slots_retired += (int64_t)freed.size();
         }
     }
-    self->dev_done.fetch_add(1, std::memory_order_relaxed);
+    self->dev_done.fetch_add(
+        self->weighted ? (*self->weight)[(size_t)t] : 1,
+        std::memory_order_relaxed);
     ptrace_ring::Writer tw;
     tw.open(self->trace.load(std::memory_order_acquire));
     if (tw.st) {
         // the device task's retire step as a (tiny) EV_TASK interval so
-        // merged traces pair every lane task exactly like CPU retires
+        // merged traces pair every lane task exactly like CPU retires;
+        // fused-region nodes additionally mark EV_REGION so the merged
+        // timeline separates regions from seams on the retire side too
+        if (self->weighted && (*self->weight)[(size_t)t] > 1) {
+            tw.rec(EV_REGION, t, ptrace_ring::FLAG_START);
+            tw.rec(EV_REGION, t, ptrace_ring::FLAG_END);
+        }
         tw.rec(EV_TASK, t, ptrace_ring::FLAG_START);
         tw.rec(EV_TASK, t, ptrace_ring::FLAG_END);
     }
@@ -1204,7 +1255,9 @@ PyObject *graph_dev_retire(PyObject *obj, PyObject *arg) {
 PyObject *graph_dev_stats(PyObject *obj, PyObject *) {
     Graph *self = reinterpret_cast<Graph *>(obj);
     int64_t ndev = 0;
-    for (uint8_t m : *self->dev_mask) ndev += m;
+    for (size_t i = 0; i < self->dev_mask->size(); i++)
+        if ((*self->dev_mask)[i])
+            ndev += self->weighted ? (*self->weight)[i] : 1;
     return Py_BuildValue(
         "{s:L,s:L,s:L,s:L}",
         "dev_tx", (long long)self->dev_tx.load(std::memory_order_relaxed),
@@ -1212,6 +1265,87 @@ PyObject *graph_dev_stats(PyObject *obj, PyObject *) {
         (long long)self->dev_done.load(std::memory_order_relaxed),
         "dev_bad", (long long)self->dev_bad.load(std::memory_order_relaxed),
         "n_dev", (long long)ndev);
+}
+
+// ------------------------------------------------------ region fusion bind
+
+// region_bind(weights) — declare fused super-task nodes (ISSUE 12). The
+// compiler's fusion pass already rebuilt the CSR so each fused node
+// carries the union of its region's external in/out edges and in-slot
+// list; `weights[i]` says how many ORIGINAL tasks node i stands for
+// (1 for seams and unfused tasks, the region size for a fused node).
+// From here completed/pending/done and run()'s return value are
+// original-task denominated, so pool accounting and engagement counters
+// never under-report a fused pool. Single-rank only (fusion declines
+// distributed pools: a fused region must not hide a cross-rank edge).
+PyObject *graph_region_bind(PyObject *obj, PyObject *arg) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::vector<int32_t> w;
+    if (!parse_i32_list(arg, w, "weights: sequence of ints"))
+        return nullptr;
+    if ((int64_t)w.size() != self->n) {
+        PyErr_SetString(PyExc_ValueError, "weights must have n entries");
+        return nullptr;
+    }
+    int64_t total = 0;
+    for (int32_t v : w) {
+        if (v < 1) {
+            PyErr_SetString(PyExc_ValueError, "region weight must be >= 1");
+            return nullptr;
+        }
+        total += v;
+    }
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (self->running > 0 || self->completed > 0) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "region_bind() on a graph already running");
+        return nullptr;
+    }
+    if (self->comm_bound) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "region_bind() on a comm-bound graph (fusion is "
+                        "single-rank)");
+        return nullptr;
+    }
+    *self->weight = std::move(w);
+    self->w_total = total;
+    self->weighted = true;
+    return Py_BuildValue("L", (long long)total);
+}
+
+PyObject *graph_region_stats(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    int64_t regions = 0, fused = 0;
+    for (int32_t v : *self->weight) {
+        if (v > 1) {
+            regions++;
+            fused += v;
+        }
+    }
+    return Py_BuildValue(
+        "{s:L,s:L,s:L,s:L}",
+        "fused_regions", (long long)regions,
+        "fused_tasks", (long long)fused,
+        "nodes", (long long)self->n,
+        "weighted_total", (long long)(self->weighted ? self->w_total
+                                                     : self->n_local));
+}
+
+// trace_mark(key, id, flags) — record one event into this graph's rings
+// from Python (GIL held). The region dispatch wrappers bracket each
+// fused-region body with EV_REGION START/END so merged Perfetto
+// timelines show regions vs seams; a disarmed tracer costs one null
+// branch (Writer.open on a null state).
+PyObject *graph_trace_mark(PyObject *obj, PyObject *args) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    unsigned int key, flags;
+    long long id;
+    if (!PyArg_ParseTuple(args, "ILI", &key, &id, &flags))
+        return nullptr;
+    ptrace_ring::Writer tw;
+    tw.open(self->trace.load(std::memory_order_acquire));
+    if (tw.st) tw.rec(key, (int64_t)id, flags);
+    Py_RETURN_NONE;
 }
 
 // --------------------------------------------------- scheduler plane bind
@@ -1477,6 +1611,15 @@ PyMethodDef graph_methods[] = {
      "dev_retire(tid): one device task completed; run its release walk"},
     {"dev_stats", graph_dev_stats, METH_NOARGS,
      "{dev_tx, dev_done, dev_bad, n_dev}"},
+    {"region_bind", graph_region_bind, METH_O,
+     "region_bind(weights) -> weighted total: declare fused super-task "
+     "nodes (weight = original tasks per node); completed/pending/done "
+     "and run() become original-task denominated"},
+    {"region_stats", graph_region_stats, METH_NOARGS,
+     "{fused_regions, fused_tasks, nodes, weighted_total}"},
+    {"trace_mark", graph_trace_mark, METH_VARARGS,
+     "trace_mark(key, id, flags): record one ring event from Python "
+     "(EV_REGION dispatch intervals of the fused-region wrappers)"},
     {"trace_enable", graph_trace_enable, METH_VARARGS,
      "trace_enable(nrings=16, capacity=65536) -> (nrings, cap): arm the "
      "in-lane event rings (idempotent; see ptrace_ring.h)"},
@@ -1530,6 +1673,10 @@ PyMODINIT_FUNC PyInit__ptexec(void) {
     }
     if (PyModule_AddIntConstant(m, "EV_TASK", EV_TASK) < 0 ||
         PyModule_AddIntConstant(m, "EV_DISPATCH", EV_DISPATCH) < 0 ||
+        PyModule_AddIntConstant(m, "EV_REGION", EV_REGION) < 0 ||
+        PyModule_AddIntConstant(m, "FLAG_START",
+                                ptrace_ring::FLAG_START) < 0 ||
+        PyModule_AddIntConstant(m, "FLAG_END", ptrace_ring::FLAG_END) < 0 ||
         PyModule_AddIntConstant(m, "HIST_BUCKETS", pthist::NBUCKETS) < 0 ||
         PyModule_AddIntConstant(m, "HIST_SUB_BITS", pthist::SUB_BITS) < 0 ||
         PyModule_AddIntConstant(m, "HIST_READY_SAMPLE", 8) < 0) {

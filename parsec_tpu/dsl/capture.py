@@ -64,13 +64,20 @@ class CaptureDeferred(Exception):
     a single non-capturable task."""
 
 #: process-wide compiled-program cache: the same DAG shape (op sequence,
-#: tile shapes/dtypes, scalar params) compiles exactly once. Keys hold the
-#: body function OBJECTS (identity equality — two closures over different
+#: tile shapes/dtypes, scalar params, device fingerprint) compiles exactly
+#: once — shared ACROSS pool instantiations, so steady-state serving
+#: (the repeated-DAG shape of heavy traffic) re-runs a warm executable
+#: instead of paying trace+compile per request. Keys hold the body
+#: function OBJECTS (identity equality — two closures over different
 #: constants must never share a program), so the cache is LRU-bounded:
-#: lambda-per-call users pay a recompile past the bound instead of leaking
-#: a compiled executable per capture.
+#: lambda-per-call users pay a recompile past the bound instead of
+#: leaking a compiled executable per capture. Hit/miss/evict counters
+#: export through the unified registry as ``capture.cache_*``
+#: (ISSUE 12; see dsl/fusion.py ExecCache).
+from .fusion import ExecCache, device_fingerprint
+
 _PROGRAM_CACHE_MAX = 64
-_program_cache: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
+_program_cache = ExecCache(_PROGRAM_CACHE_MAX)
 _cache_lock = threading.Lock()
 
 #: memoized dtype-gate verdicts (None = compatible, str = reject reason):
@@ -170,7 +177,7 @@ class GraphCapture:
         self.ops.append((fn, spec))
         self.op_extras.append((priority, where, name, tuple(raw_accs)))
 
-    def take_ops(self) -> List[Tuple]:
+    def take_ops(self, fuse: bool = False) -> List[Tuple]:
         """Hand the recorded region back as replayable
         ``(fn, args, priority, where, name)`` inserts and reset the
         recording — the auto-defer hand-off: the deferring taskpool
@@ -178,20 +185,99 @@ class GraphCapture:
         order (DTD sequential consistency makes that a valid
         serialization) with their original priorities, placement, and
         affinity bits, so nothing recorded before the non-capturable
-        insert is lost, reordered, or re-scheduled differently."""
-        out: List[Tuple] = []
-        for (fn, spec), (prio, where, name, raw_accs) in zip(
-                self.ops, self.op_extras):
+        insert is lost, reordered, or re-scheduled differently.
+
+        With ``fuse=True`` (ISSUE 12: ``--mca region_fusion``), maximal
+        runs of *fusable* recorded ops — default placement (no custom
+        ``where``, no AFFINITY/NOTRACK bits), uniform priority —
+        collapse into ONE super-task insert each: a single jittable
+        function replaying the run in insertion order over the run's
+        tiles with UNION accesses. The deferred window then schedules
+        regions + seams instead of every recorded task, so capture
+        still wins where it applies even when the window as a whole
+        could not compile. Landing semantics match capture's own: one
+        version bump per written tile per region. Each fused function
+        carries ``_ptdtd_fused`` = the member count (engagement
+        accounting for the deferring pool)."""
+        from ..core.task import DEV_ALL
+        from .dtd import RW, WRITE
+        ops, extras, tiles = self.ops, self.op_extras, self._tiles
+        self._clear_recording()
+
+        def per_task(i: int) -> Tuple:
+            fn, spec = ops[i]
+            prio, where, name, raw_accs = extras[i]
             args: List[Any] = []
             fi = 0
             for e in spec:
                 if e[0] == "flow":
-                    args.append((self._tiles[e[1]], raw_accs[fi]))
+                    args.append((tiles[e[1]], raw_accs[fi]))
                     fi += 1
                 else:
                     args.append(e[1])
-            out.append((fn, args, prio, where, name))
-        self._clear_recording()
+            return (fn, args, prio, where, name)
+
+        if not fuse:
+            return [per_task(i) for i in range(len(ops))]
+
+        def fusable(i: int) -> bool:
+            # default placement only: a custom device restriction,
+            # AFFINITY, or NOTRACK bit must keep its own insert
+            _prio, where, _name, raw_accs = extras[i]
+            return where in (None, DEV_ALL) and \
+                all((acc & ~RW) == 0 for acc in raw_accs)
+
+        def fuse_run(lo: int, hi: int) -> Tuple:
+            run = ops[lo:hi]
+            t_ix: Dict[int, int] = {}     # recording tile ix -> local
+            t_list: List[int] = []
+            accs: List[int] = []
+            for _fn, spec in run:
+                for e in spec:
+                    if e[0] == "flow":
+                        li = t_ix.get(e[1])
+                        if li is None:
+                            li = t_ix[e[1]] = len(t_list)
+                            t_list.append(e[1])
+                            accs.append(0)
+                        accs[li] |= e[2]
+            written_l = [li for li in range(len(t_list))
+                         if accs[li] & WRITE]
+            arr_vals = [e[1] for _fn, spec in run for e in spec
+                        if e[0] == "array"]
+
+            def region_fn(*vals, _run=run, _t_ix=t_ix,
+                          _written=tuple(written_l), _arrs=arr_vals):
+                env = list(vals)
+                GraphCapture._replay(
+                    _run, lambda gi: env[_t_ix[gi]],
+                    lambda gi, v: env.__setitem__(_t_ix[gi], v), _arrs)
+                return tuple(env[li] for li in _written)
+
+            region_fn._ptdtd_fused = hi - lo
+            args = [(tiles[gi], accs[li]) for li, gi in enumerate(t_list)]
+            prio, _w, name, _a = extras[lo]
+            return (region_fn, args, prio, None,
+                    f"fused[{hi - lo}]" + (f":{name}" if name else ""))
+
+        rmin = int(mca.get("region_fusion_min", 2))
+        rmax = int(mca.get("region_fusion_max", 128))
+        out: List[Tuple] = []
+        i, n = 0, len(ops)
+        while i < n:
+            if not fusable(i):
+                out.append(per_task(i))
+                i += 1
+                continue
+            j = i + 1
+            while j < n and j - i < rmax and fusable(j) \
+                    and extras[j][0] == extras[i][0]:   # uniform priority
+                j += 1
+            if j - i >= rmin:
+                out.append(fuse_run(i, j))
+            else:
+                out.extend(per_task(k) for k in range(i, j))
+            i = j
         return out
 
     def _tile_index(self, tile) -> int:
@@ -218,7 +304,9 @@ class GraphCapture:
             op_sig.append((fn, tuple(entries)))
         tiles_sig = tuple((tuple(np.shape(v)), str(getattr(v, "dtype", type(v))))
                           for v in tile_vals)
-        return (tuple(op_sig), tiles_sig)
+        # device fingerprint: a cached executable can never be replayed
+        # against a different backend/device layout (ISSUE 12 satellite)
+        return (tuple(op_sig), tiles_sig, device_fingerprint())
 
     def _written(self) -> List[int]:
         from .dtd import WRITE
@@ -440,17 +528,9 @@ class GraphCapture:
                tuple((len(ixs),) + tuple(np.shape(tile_vals[ixs[0]]))
                      + (str(getattr(tile_vals[ixs[0]], "dtype", "")),)
                      for ixs in stores),
-               len(rows), flow_idx.shape[1])
-        with _cache_lock:
-            jitted = _program_cache.get(sig)
-            self.cache_hit = jitted is not None
-            if jitted is None:
-                jitted = jax.jit(self._build_scan(classes))
-                _program_cache[sig] = jitted
-                while len(_program_cache) > _PROGRAM_CACHE_MAX:
-                    _program_cache.popitem(last=False)
-            else:
-                _program_cache.move_to_end(sig)
+               len(rows), flow_idx.shape[1], device_fingerprint())
+        jitted, self.cache_hit = _program_cache.get_or_build(
+            sig, lambda: jax.jit(self._build_scan(classes)))
 
         store_vals = tuple(jnp.stack([tile_vals[i] for i in ixs])
                            for ixs in stores)
@@ -520,17 +600,14 @@ class GraphCapture:
             written, results = self._execute_scan(tile_vals, plan)
         else:
             sig = self._signature(tile_vals)
-            with _cache_lock:
-                jitted = _program_cache.get(sig)
-                self.cache_hit = jitted is not None
-                if jitted is None:
-                    program, written = self._build()
-                    jitted = (jax.jit(program), written)
-                    _program_cache[sig] = jitted
-                    while len(_program_cache) > _PROGRAM_CACHE_MAX:
-                        _program_cache.popitem(last=False)
-                else:
-                    _program_cache.move_to_end(sig)
+
+            def _build_jitted():
+                import jax as _jax
+                program, written = self._build()
+                return (_jax.jit(program), written)
+
+            jitted, self.cache_hit = _program_cache.get_or_build(
+                sig, _build_jitted)
             fn, written = jitted
             results = fn(tuple(tile_vals), tuple(arr_vals))
         # land results exactly like task completions would (cpu-hook tail)
@@ -692,16 +769,8 @@ class GraphCapture:
                      for n in coll_names),
                tuple(mesh.devices.shape), tuple(mesh.axis_names), axes,
                tuple(d.id for d in mesh.devices.flat))
-        with _cache_lock:
-            jitted = _program_cache.get(sig)
-            self.cache_hit = jitted is not None
-            if jitted is None:
-                jitted = build_mesh_program()
-                _program_cache[sig] = jitted
-                while len(_program_cache) > _PROGRAM_CACHE_MAX:
-                    _program_cache.popitem(last=False)
-            else:
-                _program_cache.move_to_end(sig)
+        jitted, self.cache_hit = _program_cache.get_or_build(
+            sig, build_mesh_program)
         # kept for sharding-quality introspection (mesh_hlo): jax caches
         # the executable, so lowering these args again is trace-only cost
         self._last_mesh_call = (jitted, (tuple(globals_in),

@@ -88,7 +88,11 @@ from ..utils.counters import LaneStats as _LaneStats
 
 PTDTD_STATS = _LaneStats(pools_batch=0, tasks_batched=0, tasks_per_task=0,
                          batches=0, classes_ineligible=0,
-                         capture_windows_deferred=0)
+                         capture_windows_deferred=0,
+                         # ISSUE 12: deferred-window region fusion —
+                         # capturable runs of a deferred capture window
+                         # replay as ONE fused super-task insert each
+                         capture_regions_fused=0, capture_tasks_fused=0)
 
 #: "batch registration not yet attempted" marker for the one-entry class
 #: cache (None means attempted-and-ineligible, which must not retry)
@@ -1135,9 +1139,19 @@ class DTDTaskpool(Taskpool):
                                      f"the scheduler ({e})")
                 self._capture_deferred = True
                 PTDTD_STATS["capture_windows_deferred"] += 1
-                replays = self._capture.take_ops()
-                self.inserted -= len(replays)   # re-counted by the replay
+                n_rec = len(self._capture.ops)
+                # region fusion (ISSUE 12): capturable RUNS of the
+                # deferred window collapse into one super-task insert
+                # each — capture still wins where it applies, the
+                # scheduler handles only the seams
+                replays = self._capture.take_ops(
+                    fuse=bool(mca.get("region_fusion", True)))
+                self.inserted -= n_rec          # re-counted by the replay
                 for rfn, rargs, rprio, rwhere, rname in replays:
+                    nf = getattr(rfn, "_ptdtd_fused", 0)
+                    if nf:
+                        PTDTD_STATS["capture_regions_fused"] += 1
+                        PTDTD_STATS["capture_tasks_fused"] += nf
                     self._insert_task_locked(rfn, rargs, rprio,
                                              DEV_ALL if rwhere is None
                                              else rwhere, rname or None,

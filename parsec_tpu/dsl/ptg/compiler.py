@@ -69,10 +69,15 @@ mca.register("ptg_native_exec", True,
 #: failure. utils/counters.install_native_counters exports these under
 #: ``ptexec.*`` for live_view and the SDE-style snapshot
 from ...utils.counters import LaneStats as _LaneStats
+from ..fusion import ExecCache, device_fingerprint, partition_regions
 
 PTEXEC_STATS = _LaneStats(pools_engaged=0, tasks_engaged=0,
                           pools_fallback=0, pools_ineligible=0,
-                          pools_device=0, tasks_device=0)
+                          pools_device=0, tasks_device=0,
+                          # region fusion (ISSUE 12): original tasks
+                          # collapsed into fused super-tasks vs tasks the
+                          # scheduler still handles per-task (the seams)
+                          fused_regions=0, fused_tasks=0, seam_tasks=0)
 
 _ACCESS_MAP = {
     P.FLOW_READ: FLOW_ACCESS_READ,
@@ -132,6 +137,49 @@ def _index_expr(src: str):
         elif c == "." and depth == 0 and src[i:i+2] == ".." and src[i:i+3] != "...":
             return _RangeExpr(src[:i], src[i+2:])
     return _Expr(src)
+
+
+def _mk_region_program(rp: Dict[str, Any], fns, written_by_class):
+    """The fused super-task's body (ISSUE 12): ONE traceable program
+    replaying the region's members in serialization order (topo order of
+    the member subgraph — a valid serialization, the DTD-capture
+    soundness argument applied to a PTG region). Internal dataflow rides
+    a trace-time slot env (XLA recovers the DAG from the value
+    dependencies and re-fuses across task boundaries); member memory
+    WRITES feed later members' memory READS of the same (collection,
+    index) through a trace-time mem env, matching the per-task path's
+    release-edge ordering. Returns (externally-consumed slot values,
+    member write-back values in emission order). Pure w.r.t. its inputs
+    — safe to jit once and reuse across pool instantiations."""
+    steps, out_slots = rp["steps"], rp["out_slots"]
+
+    def region_program(ext_vals):
+        env: Dict[int, Any] = {}
+        menv: Dict[Tuple, Any] = {}
+        wb_vals: List[Any] = []
+        for ci, key, srcs, base, nd, wbs in steps:
+            vals: List[Any] = []
+            for kk, v in srcs:
+                if kk == "int":
+                    vals.append(env[v])
+                elif kk == "ext":
+                    vals.append(ext_vals[v])
+                elif kk == "intm":
+                    vals.append(menv[v])
+                else:                      # "none": NEW/no input
+                    vals.append(None)
+            fn = fns[ci]
+            if fn is not None:
+                outs = fn(*key, *vals)
+                for oj, dj in enumerate(written_by_class[ci]):
+                    vals[dj] = outs[oj]
+            for dj in range(nd):
+                env[base + dj] = vals[dj]
+            for dj, mk in wbs:
+                menv[mk] = vals[dj]
+                wb_vals.append(vals[dj])
+        return (tuple(env[s] for s in out_slots), tuple(wb_vals))
+    return region_program
 
 
 class PTGTaskpool(Taskpool):
@@ -936,12 +984,20 @@ class PTGTaskpool(Taskpool):
     _PTEXEC_SAFE_ENV = {"min": min, "max": max, "abs": abs, "range": range,
                         "len": len, "int": int, "divmod": divmod}
 
-    def _ptexec_cache_key(self, names: Tuple[str, ...]):
+    def _ptexec_cache_key(self, names: Tuple[str, ...], place: Tuple):
         """Cache signature for the flattened graph: the task space and the
         edge structure depend only on the program text and the globals the
         range/guard/index expressions read. Non-primitive globals (incl.
         user callables a guard might invoke) make the instantiation
-        uncacheable — flatten still runs, per pool."""
+        uncacheable — flatten still runs, per pool.
+
+        ``place`` is the placement fingerprint (ISSUE 12 satellite):
+        (nb_ranks, comm lane, device lane, device fingerprint, fusion
+        config). The cached entry now carries the FUSION PLAN — which
+        depends on which classes ride the device lane and on the fusion
+        knobs — and the region executable cache hangs off this key, so a
+        cached CSR (or compiled region program) can never be replayed
+        against a different mesh/device layout."""
         sig = []
         for k, v in self.env_base.items():
             if k == "__builtins__" or self._PTEXEC_SAFE_ENV.get(k) is v:
@@ -950,7 +1006,7 @@ class PTGTaskpool(Taskpool):
                 sig.append((k, v))
             else:
                 return None
-        return (tuple(sorted(sig)), names)
+        return (tuple(sorted(sig)), names, place)
 
     def _ptexec_flatten(self, classes: List[TaskClass]):
         """Emit the flattened tables the native lane consumes (the jdf2c
@@ -1203,15 +1259,29 @@ class PTGTaskpool(Taskpool):
                 PTDEV_STATS["pools_fallback"] += 1
                 return None
         names = tuple(tc._ptg_spec.name for tc in classes)
-        key = self._ptexec_cache_key(names)
+        place = (ctx.nb_ranks, lane_comm is not None, use_dev,
+                 device_fingerprint(),
+                 bool(mca.get("region_fusion", True)),
+                 int(mca.get("region_fusion_min", 2)),
+                 int(mca.get("region_fusion_max", 128)))
+        key = self._ptexec_cache_key(names, place)
         cache = self.program.__dict__.setdefault("_ptexec_cache", {})
-        flat = cache.get(key) if key is not None else None
-        if flat is None:
+        ent = cache.get(key) if key is not None else None
+        if ent is None:
             flat = self._ptexec_flatten(classes)
             if flat is None:
                 return None
+            plan = None
+            if flat["n"] and flat["data"] is not None \
+                    and lane_comm is None:
+                # the fusion pass (ISSUE 12): single-rank data pools only
+                # — a fused region must never hide a cross-rank edge
+                plan = self._ptexec_fuse_plan(flat, classes, dev_classes,
+                                              use_dev)
+            ent = {"flat": flat, "fusion": plan}
             if key is not None:
-                cache[key] = flat
+                cache[key] = ent
+        flat = ent["flat"]
         owners = None
         if lane_comm is not None:
             # per-task owner ranks (owner-computes affinity) — computed
@@ -1244,6 +1314,15 @@ class PTGTaskpool(Taskpool):
             if owners is not None:
                 self._ptexec_bind_comm(lane, lane_comm, owners)
             return lane
+        # data-flow pool with a FUSION PLAN (ISSUE 12): capturable
+        # subgraphs collapse into fused super-tasks — one jitted program
+        # per region, dispatched through the normal callback (CPU
+        # regions) or the ptdev lane (device regions) — and the graph
+        # carries only regions + seams, weighted back to original tasks.
+        if ent.get("fusion") is not None and owners is None:
+            return self._ptexec_lane_fused(flat, ent["fusion"], classes,
+                                           mod, key,
+                                           devlane if use_dev else None)
         # data-flow pool: the graph additionally owns slot LIFETIMES (the
         # usagelmt/usagecnt retire protocol); Python owns slot VALUES —
         # one flat list the batched callback reads inputs from and lands
@@ -1296,6 +1375,412 @@ class PTGTaskpool(Taskpool):
                                   dev_classes, slots, mem_datas, writebacks)
         return lane
 
+    # ---------------------------------------------- region fusion (ISSUE 12)
+    def _ptexec_fuse_plan(self, flat, classes: List[TaskClass],
+                          dev_classes: List[bool],
+                          use_dev: bool) -> Optional[Dict[str, Any]]:
+        """The fusion pass over the flattened CSR: identify capturable
+        subgraphs — same-device jittable bodies (the class's single
+        jitted ``_ptg_body_fn``, or an empty forwarding body), static
+        shapes (automatic: jit traces per shape), no cross-rank edge
+        (the caller only fuses single-rank pools) — and collapse each
+        into ONE fused super-task node. Returns the fused COMPACT graph
+        (regions + seams; a fused node inherits the union of its
+        region's external in/out edges and in-slot lists, so the C
+        release walk and the slot-retire protocol cross the seam
+        correctly) plus per-region replay plans, or None when nothing
+        is worth fusing. Pure structure — no per-instantiation objects
+        — so the whole plan rides the flatten cache."""
+        if not mca.get("region_fusion", True):
+            return None
+        data = flat["data"]
+        n = flat["n"]
+        cls_of = data["cls_of"]
+        ndflows = data["ndflows"]
+        # per-class capturability kind: None = seam (un-fusable)
+        kind_by_class: List[Optional[str]] = []
+        for ci, tc in enumerate(classes):
+            if ndflows[ci] == 0:
+                # CTL/flowless classes run raw Python bodies — seams
+                kind_by_class.append(None)
+                continue
+            empty = tc._ptg_spec.bodies[0].source.strip() in ("", "pass")
+            if not empty and getattr(tc, "_ptg_body_fn", None) is None:
+                kind_by_class.append(None)
+                continue
+            kind_by_class.append("dev" if (use_dev and dev_classes[ci])
+                                 else "cpu")
+        if not any(k is not None for k in kind_by_class):
+            return None
+        empty_body = [tc._ptg_spec.bodies[0].source.strip() in ("", "pass")
+                      for tc in classes]
+        slot_base0, in_refs0 = data["slot_base"], data["in_refs"]
+        kind: List[Optional[str]] = []
+        for t in range(n):
+            ci = cls_of[t]
+            k = kind_by_class[ci]
+            if k is not None and empty_body[ci]:
+                # an empty (forwarding) body with a NEW/NULL or memory
+                # input can forward None — the per-task path's "A NULL
+                # is forwarded" source guard must keep firing at the
+                # producer, so such tasks stay seams (a fused region
+                # would swallow the None into its trace env)
+                b = slot_base0[t]
+                for dj in range(data["ndflows"][ci]):
+                    if in_refs0[b + dj] < 0:
+                        k = None
+                        break
+            kind.append(k)
+        regions = partition_regions(
+            n, flat["off"], flat["succs"], kind,
+            int(mca.get("region_fusion_min", 2)),
+            int(mca.get("region_fusion_max", 128)))
+        if not regions:
+            return None
+        off, succs = flat["off"], flat["succs"]
+        in_off, in_slots = data["in_off"], data["in_slots"]
+        slot_base, in_refs = data["slot_base"], data["in_refs"]
+        mem_reads = data["mem_reads"]
+        reg_of = [-1] * n
+        for ri, members in enumerate(regions):
+            for m in members:
+                reg_of[m] = ri
+        member_sets = [set(m) for m in regions]
+        task_of_slot = [0] * data["n_slots"]
+        for t in range(n):
+            b = slot_base[t]
+            for dj in range(ndflows[cls_of[t]]):
+                task_of_slot[b + dj] = t
+        # compact node list: seams/unfused keep their own node; each
+        # region becomes ONE node at its topo-first member's id position
+        rep_of = [m[0] for m in regions]
+        node: List[Tuple] = []
+        cid_of = [0] * n
+        rcid = [-1] * len(regions)
+        for i in range(n):
+            ri = reg_of[i]
+            if ri < 0:
+                cid_of[i] = len(node)
+                node.append(("t", i))
+            elif i == rep_of[ri]:
+                rcid[ri] = len(node)
+                node.append(("r", ri))
+        for i in range(n):
+            if reg_of[i] >= 0:
+                cid_of[i] = rcid[reg_of[i]]
+        nc = len(node)
+        # edges: internal (both ends one region) drop; the rest remap —
+        # a fused node thereby inherits the union of its region's
+        # external out-edges, and goals recount to external in-edges
+        edges2: List[List[int]] = [[] for _ in range(nc)]
+        for i in range(n):
+            src = cid_of[i]
+            ri = reg_of[i]
+            for k in range(off[i], off[i + 1]):
+                t = succs[k]
+                if ri >= 0 and reg_of[t] == ri:
+                    continue
+                edges2[src].append(cid_of[t])
+        goals2 = [0] * nc
+        for es in edges2:
+            for d in es:
+                goals2[d] += 1
+        off2 = [0] * (nc + 1)
+        succs2: List[int] = []
+        for i2, es in enumerate(edges2):
+            off2[i2 + 1] = off2[i2] + len(es)
+            succs2.extend(es)
+        prio = flat["prio"]
+        prio2 = None
+        if prio is not None:
+            prio2 = [prio[nd[1]] if nd[0] == "t"
+                     else max(prio[m] for m in regions[nd[1]])
+                     for nd in node]
+        # in-slot lists (the retire protocol): a fused node consumes the
+        # multiset of its members' EXTERNAL input slots — decrementing k
+        # uses at region retire matches the k per-member decrements the
+        # unfused walk would have done; internal consumption vanishes
+        # (the region reads those values from its own trace env)
+        in2: List[List[int]] = [[] for _ in range(nc)]
+        for i2, nd in enumerate(node):
+            if nd[0] == "t":
+                i = nd[1]
+                in2[i2] = list(in_slots[in_off[i]:in_off[i + 1]])
+            else:
+                mem = member_sets[nd[1]]
+                in2[i2] = [ref for m in regions[nd[1]]
+                           for ref in in_slots[in_off[m]:in_off[m + 1]]
+                           if task_of_slot[ref] not in mem]
+        in_off2 = [0] * (nc + 1)
+        in_slots2: List[int] = []
+        for i2, lst in enumerate(in2):
+            in_off2[i2 + 1] = in_off2[i2] + len(lst)
+            in_slots2.extend(lst)
+        slot_uses2 = [0] * data["n_slots"]
+        for ref in in_slots2:
+            slot_uses2[ref] += 1
+        # per-region replay plans: members in topo order (a valid
+        # serialization — the same argument as DTD capture: insertion/
+        # topo order respects every internal edge), each flow input
+        # resolved statically to an internal value, an external slot, a
+        # memory read, or an earlier member's memory WRITE (the region-
+        # internal mem env — per-task dispatch would also order those
+        # through the release edges)
+        wb_by_task: Dict[int, List[Tuple]] = {}
+        for tid, dj, dcn, idx in data["writebacks"]:
+            wb_by_task.setdefault(tid, []).append((dj, dcn, idx))
+        bases = flat["bases"]
+        params_by_class = flat["params"]
+        rplans: List[Dict[str, Any]] = []
+        for ri, members in enumerate(regions):
+            ext: List[Tuple] = []
+            ext_ix: Dict[Tuple, int] = {}
+
+            def eix(e):
+                j = ext_ix.get(e)
+                if j is None:
+                    j = ext_ix[e] = len(ext)
+                    ext.append(e)
+                return j
+
+            steps: List[Tuple] = []
+            produced: set = set()
+            memw: set = set()
+            wb_keys: List[Tuple] = []
+            for m in members:
+                ci = cls_of[m]
+                b = slot_base[m]
+                nd_ = ndflows[ci]
+                srcs: List[Tuple] = []
+                for dj in range(nd_):
+                    r = in_refs[b + dj]
+                    if r == -1:
+                        srcs.append(("none", 0))
+                    elif r >= 0:
+                        srcs.append(("int", r) if r in produced
+                                    else ("ext", eix(("slot", r))))
+                    else:
+                        mi = -2 - r
+                        mk = mem_reads[mi]
+                        srcs.append(("intm", mk) if mk in memw
+                                    else ("ext", eix(("mem", mi))))
+                wbs = tuple((dj, (dcn, idx))
+                            for dj, dcn, idx in wb_by_task.get(m, ()))
+                steps.append((ci, tuple(params_by_class[ci][m - bases[ci]]),
+                              tuple(srcs), b, nd_, wbs))
+                for dj in range(nd_):
+                    produced.add(b + dj)
+                for dj, mk in wbs:
+                    memw.add(mk)
+                    wb_keys.append(mk)
+            outs = [slot_base[m] + dj for m in members
+                    for dj in range(ndflows[cls_of[m]])
+                    if slot_uses2[slot_base[m] + dj] > 0]
+            rplans.append({"members": list(members),
+                           "kind": kind[members[0]],
+                           "ext": ext,
+                           "ext_mems": [v for k2, v in ext if k2 == "mem"],
+                           "steps": steps, "wb_keys": wb_keys,
+                           "out_slots": outs})
+        dev_mask2 = None
+        ndev_tasks = 0
+        if use_dev:
+            dev_mask2 = []
+            for nd in node:
+                if nd[0] == "t":
+                    i = nd[1]
+                    d = 1 if (dev_classes[cls_of[i]]
+                              and ndflows[cls_of[i]] > 0) else 0
+                    dev_mask2.append(d)
+                    ndev_tasks += d
+                else:
+                    d = 1 if rplans[nd[1]]["kind"] == "dev" else 0
+                    dev_mask2.append(d)
+                    if d:
+                        ndev_tasks += len(regions[nd[1]])
+            if ndev_tasks == 0:
+                dev_mask2 = None
+        n_fused = sum(len(m) for m in regions)
+        return {"node": node, "goals": goals2, "off": off2,
+                "succs": succs2, "prio": prio2, "in_off": in_off2,
+                "in_slots": in_slots2, "slot_uses": slot_uses2,
+                "weights": [1 if nd[0] == "t" else len(regions[nd[1]])
+                            for nd in node],
+                "orig_of": [nd[1] if nd[0] == "t" else rep_of[nd[1]]
+                            for nd in node],
+                "rcid": rcid, "regions": rplans,
+                "writebacks": [w for w in data["writebacks"]
+                               if reg_of[w[0]] < 0],
+                "dev_mask": dev_mask2, "ndev_tasks": ndev_tasks,
+                "n_seam": n - n_fused, "n_fused": n_fused}
+
+    def _ptexec_class_fns(self, classes: List[TaskClass], data):
+        """Per-class (dispatch fn, written flow positions): the jitted
+        body for data classes, the raw body for CTL classes, None for
+        empty bodies. One home — the batched data callback, the device
+        dispatch, and the region program builder must agree."""
+        fns, written = [], []
+        for ci, tc in enumerate(classes):
+            empty = tc._ptg_spec.bodies[0].source.strip() in ("", "pass")
+            if data["ndflows"][ci]:
+                fns.append(None if empty else tc._ptg_body_fn)
+                written.append(tuple(
+                    dj for dj, fi in enumerate(data["dflow_idx"][ci])
+                    if tc.flows[fi].access & FLOW_ACCESS_WRITE))
+            else:
+                fns.append(None if empty
+                           else getattr(tc, "_ptg_raw_body", None))
+                written.append(())
+        return fns, written
+
+    def _mk_region_runner(self, graph, cid: int, rp: Dict[str, Any],
+                          jitted, slots: List[Any], mem_datas,
+                          wb_datas, mod):
+        """The fused super-task's dispatch wrapper (CPU regions, called
+        from the batched data callback): resolve the region's external
+        inputs (producer slots + memory reads at dispatch time — the
+        same prepare-at-ready timing as per-task dispatch), run the ONE
+        jitted region program, land externally-consumed outputs back
+        into their original slot ids, and perform the members' memory
+        write-backs in serialization order (one version bump per member
+        write, like the per-task path). Brackets the body in EV_REGION
+        ring events so merged timelines show regions vs seams."""
+        from ...data.data import COHERENCY_OWNED as _OWNED
+        ext, out_slots = rp["ext"], rp["out_slots"]
+        evr, fs, fe = mod.EV_REGION, mod.FLAG_START, mod.FLAG_END
+
+        def run_region():
+            graph.trace_mark(evr, cid, fs)
+            ev: List[Any] = []
+            for kk, v in ext:
+                if kk == "slot":
+                    ev.append(slots[v])
+                else:
+                    copy = mem_datas[v].newest_copy()
+                    ev.append(None if copy is None else copy.payload)
+            outs, wbs = jitted(tuple(ev))
+            for s, v in zip(out_slots, outs):
+                if v is None:
+                    raise RuntimeError(
+                        f"A NULL is forwarded from fused region {cid} "
+                        f"(slot {s}, native lane)")
+                slots[s] = v
+            for dref, v in zip(wb_datas, wbs):
+                host = dref.get_copy(0)
+                if host is None:
+                    dref.create_copy(0, v, _OWNED)
+                else:
+                    host.payload = v
+                dref.bump_version(0)
+            graph.trace_mark(evr, cid, fe)
+        return run_region
+
+    def _ptexec_lane_fused(self, flat, plan, classes: List[TaskClass],
+                           mod, ckey, devlane) -> Dict[str, Any]:
+        """Build the native-lane state for a pool with a fusion plan:
+        the compact graph (regions + seams) with original-task weights,
+        per-region jitted programs out of the PERSISTENT executable
+        cache (program-scoped, keyed by the placement-aware flatten key
+        + region index — a second instantiation of the same DAG shape
+        reuses the compiled program with zero re-tracing), and the
+        region-aware dispatch callbacks."""
+        import jax
+        data = flat["data"]
+        graph = mod.Graph(plan["goals"], plan["off"], plan["succs"],
+                          plan["prio"], plan["in_off"], plan["in_slots"],
+                          plan["slot_uses"])
+        graph.region_bind(plan["weights"])
+        slots: List[Any] = [None] * data["n_slots"]
+        mem_datas = []
+        for dc_name, idx in data["mem_reads"]:
+            dc = self.collections.get(dc_name)
+            if dc is None:
+                output.fatal(f"PTG taskpool {self.name}: unknown "
+                             f"collection {dc_name!r}")
+            mem_datas.append(dc.data_of(*idx))
+        writebacks: Dict[int, List] = {}
+        for tid, dj, dc_name, idx in plan["writebacks"]:
+            dc = self.collections.get(dc_name)
+            if dc is None:
+                output.fatal(f"PTG taskpool {self.name}: unknown "
+                             f"collection {dc_name!r}")
+            writebacks.setdefault(tid, []).append((dj, dc.data_of(*idx)))
+        fns, written_by_class = self._ptexec_class_fns(classes, data)
+        cache = self.program.__dict__.setdefault(
+            "_region_prog_cache", ExecCache(128))
+        runners: Dict[int, Any] = {}
+        dev_regions: Dict[int, Dict[str, Any]] = {}
+        for ri, rp in enumerate(plan["regions"]):
+            jitted, _hit = cache.get_or_build(
+                None if ckey is None else (ckey, ri),
+                lambda rp=rp: jax.jit(
+                    _mk_region_program(rp, fns, written_by_class)))
+            wb_datas = []
+            for dcn, idx in rp["wb_keys"]:
+                dc = self.collections.get(dcn)
+                if dc is None:
+                    output.fatal(f"PTG taskpool {self.name}: unknown "
+                                 f"collection {dcn!r}")
+                wb_datas.append(dc.data_of(*idx))
+            cid = plan["rcid"][ri]
+            if rp["kind"] == "dev":
+                dev_regions[cid] = {
+                    "ext": rp["ext"], "ext_mems": rp["ext_mems"],
+                    "out_slots": rp["out_slots"], "jitted": jitted,
+                    "wb_pairs": list(enumerate(wb_datas)),
+                    "ntasks": len(rp["members"])}
+            else:
+                runners[cid] = self._mk_region_runner(
+                    graph, cid, rp, jitted, slots, mem_datas, wb_datas,
+                    mod)
+        lane = {"graph": graph, "slots": slots, "n": flat["n"],
+                "finalized": False}
+        lane["callback"] = self._mk_ptexec_data_callback(
+            flat, classes, slots, mem_datas, writebacks,
+            fusion={"orig_of": plan["orig_of"], "regions": runners},
+            class_fns=(fns, written_by_class))
+        PTEXEC_STATS["fused_regions"] += len(plan["regions"])
+        PTEXEC_STATS["fused_tasks"] += plan["n_fused"]
+        PTEXEC_STATS["seam_tasks"] += plan["n_seam"]
+        if devlane is not None and plan["dev_mask"] is not None:
+            self._ptexec_bind_dev_fused(lane, devlane, flat, plan,
+                                        classes, slots, mem_datas,
+                                        writebacks, dev_regions, mod)
+        return lane
+
+    def _ptexec_bind_dev_fused(self, lane: Dict[str, Any], devlane, flat,
+                               plan, classes: List[TaskClass],
+                               slots: List[Any], mem_datas,
+                               writebacks: Dict[int, List],
+                               dev_regions: Dict[int, Dict], mod) -> None:
+        """Device binding for a fused pool: same contract as
+        :meth:`_ptexec_bind_dev`, but the mask covers compact nodes and
+        device REGIONS dispatch as one region-sized async program on
+        the lane (ptdev needs nothing new beyond that region-sized
+        dispatch — the retire capsule walks the fused node exactly like
+        any device task, weighted back to original tasks)."""
+        data = flat["data"]
+        dev_of_class = [self._ptexec_class_device(tc)
+                        and data["ndflows"][ci] > 0
+                        for ci, tc in enumerate(classes)]
+        graph = lane["graph"]
+        dispatch, poll = self._mk_ptexec_dev_dispatch(
+            flat, classes, dev_of_class, slots, mem_datas, writebacks,
+            devlane, fusion={"orig_of": plan["orig_of"],
+                             "dev_regions": dev_regions, "graph": graph,
+                             "evr": mod.EV_REGION, "fls": mod.FLAG_START,
+                             "fle": mod.FLAG_END})
+        pid = devlane.bind_pool(graph, dispatch, poll)
+        lane["dev"] = devlane
+        lane["dev_pool"] = pid
+        from ...device.native import PTDEV_STATS
+        PTDEV_STATS["pools_engaged"] += 1
+        PTDEV_STATS["tasks_engaged"] += plan["ndev_tasks"]
+        PTEXEC_STATS["pools_device"] += 1
+        PTEXEC_STATS["tasks_device"] += plan["ndev_tasks"]
+        graph.dev_bind(devlane.submit_capsule(), pid, plan["dev_mask"])
+        devlane.clane.notify()
+
     def _ptexec_bind_dev(self, lane: Dict[str, Any], devlane, flat,
                          classes: List[TaskClass], dev_classes: List[bool],
                          slots: List[Any], mem_datas,
@@ -1337,7 +1822,7 @@ class PTGTaskpool(Taskpool):
     def _mk_ptexec_dev_dispatch(self, flat, classes: List[TaskClass],
                                 dev_of_class: List[bool], slots: List[Any],
                                 mem_datas, writebacks: Dict[int, List],
-                                devlane):
+                                devlane, fusion=None):
         """The device lane's per-pool dispatch/poll pair, both run on the
         lane's manager thread with the GIL held:
 
@@ -1377,6 +1862,17 @@ class PTGTaskpool(Taskpool):
                 if tc.flows[fi].access & FLOW_ACCESS_WRITE))
         import collections as _collections
         inflight: "_collections.deque" = _collections.deque()
+        if fusion is not None:
+            # fused pool (ISSUE 12): a device REGION dispatches as one
+            # region-sized async program; its inflight/retire id is the
+            # COMPACT node id (what the C release walk expects), while
+            # slot/param arrays index by original id via orig_of
+            _forig = fusion["orig_of"]
+            _dregs = fusion["dev_regions"]
+            _graph = fusion["graph"]
+            _evr, _fs, _fe = fusion["evr"], fusion["fls"], fusion["fle"]
+        else:
+            _forig = _dregs = _graph = None
 
         def dispatch(ids):
             # PUSH phase: issue every memory-endpoint stage-in for the
@@ -1389,6 +1885,17 @@ class PTGTaskpool(Taskpool):
             staged: Dict[int, Any] = {}
             batch_pins: List[Any] = []
             for i in ids:
+                if _dregs is not None:
+                    r = _dregs.get(i)
+                    if r is not None:
+                        for mi in r["ext_mems"]:
+                            if mi not in staged:
+                                copy = dev.lane_stage_in(mem_datas[mi],
+                                                         pin=True)
+                                batch_pins.append(copy)
+                                staged[mi] = copy
+                        continue
+                    i = _forig[i]
                 base = slot_base[i]
                 for dj in range(ndflows[cls_of[i]]):
                     r = in_refs[base + dj]
@@ -1402,11 +1909,39 @@ class PTGTaskpool(Taskpool):
                         staged[mi] = copy
             # EXEC phase: dispatch each ready device task asynchronously
             for i in ids:
-                k = cls_of[i]
-                base = slot_base[i]
+                oi = i
+                if _dregs is not None:
+                    r = _dregs.get(i)
+                    if r is not None:
+                        # region-sized dispatch: ONE jitted program for
+                        # the whole fused region, async like any task;
+                        # the retire id stays the compact node id
+                        pins: List[Any] = []
+                        ev: List[Any] = []
+                        for kk, v in r["ext"]:
+                            if kk == "slot":
+                                ev.append(slots[v])
+                            else:
+                                copy = staged[v]
+                                dev.pin_copy(copy)
+                                pins.append(copy)
+                                ev.append(copy.payload)
+                        _graph.trace_mark(_evr, i, _fs)
+                        outs, wbs_v = r["jitted"](tuple(ev))
+                        _graph.trace_mark(_evr, i, _fe)
+                        for s, v in zip(r["out_slots"], outs):
+                            slots[s] = v
+                        events = tuple(v for v in tuple(outs) + tuple(wbs_v)
+                                       if hasattr(v, "is_ready"))
+                        inflight.append((i, events, r["wb_pairs"],
+                                         list(wbs_v), pins, r["ntasks"]))
+                        continue
+                    oi = _forig[i]
+                k = cls_of[oi]
+                base = slot_base[oi]
                 nd = ndflows[k]
                 vals: List[Any] = []
-                pins: List[Any] = []
+                pins = []
                 for dj in range(nd):
                     r = in_refs[base + dj]
                     if r >= 0:
@@ -1421,14 +1956,15 @@ class PTGTaskpool(Taskpool):
                 fn = fns[k]
                 events = ()
                 if fn is not None:
-                    outs = fn(*params_by_class[k][i - bases[k]], *vals)
+                    outs = fn(*params_by_class[k][oi - bases[k]], *vals)
                     for oj, dj in enumerate(written_by_class[k]):
                         vals[dj] = outs[oj]
                     events = tuple(v for v in outs
                                    if hasattr(v, "is_ready"))
                 for dj in range(nd):
                     slots[base + dj] = vals[dj]
-                inflight.append((i, events, writebacks.get(i), vals, pins))
+                inflight.append((i, events, writebacks.get(oi), vals, pins,
+                                 1))
             for copy in batch_pins:         # per-task pins hold from here
                 dev.unpin_copy(copy)
             return len(ids)
@@ -1437,7 +1973,7 @@ class PTGTaskpool(Taskpool):
             done: List[int] = []
             for _ in range(len(inflight)):
                 ent = inflight.popleft()
-                i, events, wbs, vals, pins = ent
+                i, events, wbs, vals, pins, w = ent
                 if events and not all(a.is_ready() for a in events):
                     inflight.append(ent)
                     continue
@@ -1452,7 +1988,7 @@ class PTGTaskpool(Taskpool):
                         dref.bump_version(0)
                 for copy in pins:
                     dev.unpin_copy(copy)
-                dev.executed_tasks += 1
+                dev.executed_tasks += w
                 done.append(i)
             return done
 
@@ -1560,7 +2096,8 @@ class PTGTaskpool(Taskpool):
 
     def _mk_ptexec_data_callback(self, flat, classes: List[TaskClass],
                                  slots: List[Any], mem_datas,
-                                 writebacks: Dict[int, List], comm=None):
+                                 writebacks: Dict[int, List], comm=None,
+                                 fusion=None, class_fns=None):
         """Batched dispatch for data-flow pools — the lane's replacement
         for generic_prepare_input + the body hook + complete_execution +
         the repo side of generic_release_deps, amortized over one Python
@@ -1598,17 +2135,19 @@ class PTGTaskpool(Taskpool):
         slot_uses = data["slot_uses"]
         ndflows = data["ndflows"]
         cls_of = data["cls_of"]
-        fns, written_by_class = [], []
-        for ci, tc in enumerate(classes):
-            empty = tc._ptg_spec.bodies[0].source.strip() in ("", "pass")
-            if ndflows[ci]:
-                fns.append(None if empty else tc._ptg_body_fn)
-                written_by_class.append(tuple(
-                    dj for dj, fi in enumerate(data["dflow_idx"][ci])
-                    if tc.flows[fi].access & FLOW_ACCESS_WRITE))
-            else:
-                fns.append(None if empty else tc._ptg_raw_body)
-                written_by_class.append(())
+        # fused pools pass the SAME (fns, written) pair their region
+        # programs were jitted against — one object, not two derivations
+        fns, written_by_class = class_fns if class_fns is not None \
+            else self._ptexec_class_fns(classes, data)
+        if fusion is not None:
+            # fused pool: region nodes dispatch through their runner,
+            # everything else maps its compact id back to the original
+            # (the arrays above are all original-id indexed); the C side
+            # retires slots by original slot id either way
+            _forig = fusion["orig_of"]
+            _fregions = fusion["regions"]
+        else:
+            _forig = _fregions = None
         # single-data-flow classes whose flow is WRITTEN are the hot shape
         # (RW chains); the dispatch loop specializes them. A READ-only
         # single flow must take the general path: its body returns an
@@ -1655,6 +2194,12 @@ class PTGTaskpool(Taskpool):
                 for j in retired:
                     fetched.discard(j)
             for i in ids:
+                if _forig is not None:
+                    rr = _fregions.get(i)
+                    if rr is not None:
+                        rr()              # ONE fused super-task dispatch
+                        continue
+                    i = _forig[i]
                 k = _cls[i]
                 fn = fns[k]
                 nd = ndflows[k]

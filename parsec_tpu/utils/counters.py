@@ -105,6 +105,7 @@ def install_native_counters() -> None:
     from ..core import sched_plane as _sp
     from ..device import native as _dnative
     from ..dsl import dtd as _dtd
+    from ..dsl import fusion as _fus
     from ..dsl.ptg import compiler as _ptg
     from ..serving import fabric as _fab
     from . import native_trace as _nt
@@ -118,7 +119,11 @@ def install_native_counters() -> None:
                           (_cnative.PTCOMM_STATS, "ptcomm"),
                           (_dnative.PTDEV_STATS, "ptdev"),
                           (_fab.FAB_STATS, "ptfab"),
-                          (_sp.SCHED_STATS, "sched")):
+                          (_sp.SCHED_STATS, "sched"),
+                          # the persistent executable cache (ISSUE 12):
+                          # capture.cache_{hits,misses,evictions} — the
+                          # warm-pool contract on /metrics
+                          (_fus.CAPTURE_CACHE_STATS, "capture")):
         for key in stats:
             counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
     # the comm lane's C-side wire counters (summed across live lanes)

@@ -55,7 +55,10 @@ EVENT_SIZE = struct.calcsize(_EVENT_FMT)
 # native key -> PBP keyword name per lane kind (must mirror the EV_*
 # constants exported by the extension modules)
 NATIVE_KEYWORDS: Dict[str, Dict[int, str]] = {
-    "ptexec": {1: "ptexec::task", 2: "ptexec::dispatch"},
+    "ptexec": {1: "ptexec::task", 2: "ptexec::dispatch",
+               # fused-region body intervals (ISSUE 12): merged Perfetto
+               # timelines separate regions from per-task seams
+               3: "ptexec::region"},
     "ptdtd": {1: "ptdtd::link", 2: "ptdtd::exec", 3: "ptdtd::task"},
     # the comm lane's EV_COMM_* points (native/src/ptcomm.cpp): one
     # per-rank progress-thread stream, so compute/comm overlap is

@@ -611,10 +611,20 @@ def test_device_lane_under_budget_pressure(dctx):
     C = TiledMatrix("pbC", n, n, ts, ts)
     C.fill(lambda m, k: np.zeros((ts, ts), np.float32))
     prog = compile_ptg(src, "pb-gemm")
-    tp = prog.instantiate(dctx, globals={"MT": n // ts, "KT": n // ts},
-                          collections={"descA": A, "descB": B, "descC": C})
-    dctx.add_taskpool(tp)
-    dctx.wait(timeout=90)
+    # per-task staging pressure under test: region fusion stages each
+    # fused chain's tiles once per REGION (different pressure shape,
+    # covered by tests/test_fusion.py); the in-batch pin regression
+    # needs the per-task dispatch path
+    mca.set("region_fusion", False)
+    try:
+        tp = prog.instantiate(dctx,
+                              globals={"MT": n // ts, "KT": n // ts},
+                              collections={"descA": A, "descB": B,
+                                           "descC": C})
+        dctx.add_taskpool(tp)
+        dctx.wait(timeout=90)
+    finally:
+        mca.params.unset("region_fusion")
     assert tp._ptexec_state is not None and \
         tp._ptexec_state.get("dev_pool") is not None
     err = float(np.abs(C.to_dense() - a @ b).max())

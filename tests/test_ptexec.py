@@ -314,6 +314,13 @@ def _run_data_dag(params, native: bool):
     try:
         if not native:
             mca.set("ptg_native_exec", False)
+        else:
+            # region fusion pinned OFF here: this harness asserts the
+            # PER-TASK slot-retire protocol (usagelmt/usagecnt parity
+            # with the repo path), which fusion legitimately changes
+            # (internal consumption never hits the protocol). The fused
+            # variant of the same parity lives in tests/test_fusion.py.
+            mca.set("region_fusion", False)
         X = TiledMatrix("descX", 1, params["N"], 1, 1)
         X.fill(lambda m, i: np.full((1, 1), float(i), np.float32))
         Y = TiledMatrix("descY", 1, params["N"], 1, 1)
@@ -342,6 +349,8 @@ def _run_data_dag(params, native: bool):
     finally:
         if not native:
             mca.params.unset("ptg_native_exec")
+        else:
+            mca.params.unset("region_fusion")
         ctx.fini()
     return stats
 
@@ -467,6 +476,10 @@ def test_lane_data_flow_chain_engages():
            "BODY\n  X = X + 1.0\nEND\n")
     ctx = pt.Context(nb_cores=1)
     try:
+        # per-task protocol under test: region fusion (which folds the
+        # whole chain into one super-task and retires no interior slot)
+        # is exercised by tests/test_fusion.py instead
+        mca.set("region_fusion", False)
         A = TiledMatrix("laneA", 1, 4, 1, 1)
         A.fill(lambda m, k: np.zeros((1, 1), np.float32))
         prog = compile_ptg(src, "data")
@@ -485,6 +498,7 @@ def test_lane_data_flow_chain_engages():
         assert len(tp.repos[tc.task_class_id]) == 0
         assert tp.repos[tc.task_class_id].retired == 0
     finally:
+        mca.params.unset("region_fusion")
         ctx.fini()
 
 
