@@ -1277,6 +1277,40 @@ def main() -> None:
         log(f"fusion leg failed: {e}")
     persist("after fusion legs")
 
+    # ---- profile-guided adaptive runtime (ISSUE 18): online cost ------
+    # models drive device placement and fusion sizing —
+    # `adaptive_vs_static_placement_ratio` (heterogeneous mixed CPU/TPU
+    # DAG, static heuristic vs measured placement),
+    # `fusion_sizing_speedup` (many-tiny-regions DAG, static knobs vs
+    # measured break-even), `costmodel_decision_overhead_pct` (the <1%
+    # instantiation-boundary contract). Subprocess so the legs' mca
+    # toggles and learned state never leak; degrade-and-continue per key.
+    try:
+        ap = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "adaptive_bench.py")],
+            capture_output=True, text=True, timeout=900,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert ap.returncode == 0, ap.stderr[-500:]
+        al = json.loads(ap.stdout.strip().splitlines()[-1])
+        for k in ("adaptive_vs_static_placement_ratio",
+                  "placement_static_ms", "placement_adaptive_ms",
+                  "fusion_sizing_speedup", "fusion_static_ms",
+                  "fusion_adaptive_ms", "costmodel_decision_overhead_pct",
+                  "placements_diverged"):
+            if k in al:
+                results[k] = al[k]
+        tag_cpu_artifact(results, "adaptive_vs_static_placement_ratio",
+                         "fusion_sizing_speedup")
+        log(f"adaptive runtime: placement "
+            f"{al.get('adaptive_vs_static_placement_ratio')}x vs static "
+            f"({al.get('placements_diverged', 0)} diverged), fusion "
+            f"sizing {al.get('fusion_sizing_speedup')}x, decision "
+            f"overhead {al.get('costmodel_decision_overhead_pct')}%")
+    except Exception as e:  # noqa: BLE001 — degrade, keep all other keys
+        log(f"adaptive leg failed: {e}")
+    persist("after adaptive legs")
+
     # per-dispatch protocol cost of this chip path (diagnostic: on the
     # tunneled chip this is ~1000x a local PJRT dispatch and bounds any
     # task-runtime's DAG rate; recorded so the GFLOP/s numbers are readable)

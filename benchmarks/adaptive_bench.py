@@ -1,0 +1,290 @@
+#!/usr/bin/env python3
+"""Profile-guided adaptive runtime — bench & ci gate (ISSUE 18).
+
+Three legs, CPU-only friendly (the host "TPU" device of ``--mca
+device_tpu_over_cpu`` stands in for an accelerator, exactly like the
+device-lane suites):
+
+* **adaptive placement** — a heterogeneous DAG (a host-bodied class and
+  a tiny TPU-bodied class side by side). The static heuristic sends
+  every TPU-bodied task through the device lane; on a host where that
+  lane is pure overhead the online cost model measures both flavors and
+  moves the class to its CPU twin. `adaptive_vs_static_placement_ratio`
+  = static wall / adaptive wall once the model has converged (> 1.0 =
+  measurement beat the heuristic).
+
+* **fusion sizing** — a many-tiny-regions DAG (long capturable chains).
+  `fusion_sizing_speedup` = static-knob wall / model-sized wall, both
+  warm, after the model has measured unfused dispatch, fused dispatch,
+  and per-member region trace cost.
+
+* **decision overhead** — `costmodel_decision_overhead_pct`: cumulative
+  `costmodel.decision_ns` over the summed wall of every timed run. The
+  hard contract is < 1% (decisions sit at instantiation boundaries,
+  never per task); the ci gate asserts it.
+
+Gate (``--ci-gate``): cost models nonzero for every exercised (class,
+device) pair, >= 1 placement decision DIVERGING from the static
+heuristic on the mixed DAG, the overhead contract, and zero
+``pools_fallback``. Engagement and honesty, never raw throughput.
+
+Prints one JSON line per invocation.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: the heterogeneous mixed DAG: H is host-bodied, D is TPU-bodied with
+#: tiles tiny enough that a device lane on the SAME host is pure
+#: overhead — the placement the static heuristic gets wrong by design.
+_MIX_SRC = """
+%global NT
+%global KT
+%global descH
+%global descD
+
+H(k, t)
+  k = 0 .. NT-1
+  t = 0 .. KT-1
+  : descH(0, k)
+  RW X <- (t == 0) ? descH(0, k) : X H(k, t-1)
+       -> (t < KT-1) ? X H(k, t+1) : descH(0, k)
+BODY
+  X = X + 1.0
+END
+
+D(k, t)
+  k = 0 .. NT-1
+  t = 0 .. KT-1
+  : descD(0, k)
+  RW X <- (t == 0) ? descD(0, k) : X D(k, t-1)
+       -> (t < KT-1) ? X D(k, t+1) : descD(0, k)
+BODY [type=TPU]
+  X = X + 2.0
+END
+"""
+
+#: the many-tiny-regions DAG: NT independent capturable chains of KT
+#: trivial tasks each — per-task dispatch overhead is the whole cost,
+#: the raw material fusion sizing trades against trace time.
+_CHAIN_SRC = """
+%global NT
+%global KT
+%global descH
+
+C(k, t)
+  k = 0 .. NT-1
+  t = 0 .. KT-1
+  : descH(0, k)
+  RW X <- (t == 0) ? descH(0, k) : X C(k, t-1)
+       -> (t < KT-1) ? X C(k, t+1) : descH(0, k)
+BODY
+  X = X + 1.0
+END
+"""
+
+
+def _mk(name, nt, ts=8):
+    from parsec_tpu.data.matrix import TiledMatrix
+    A = TiledMatrix(name, ts, nt * ts, ts, ts)
+    A.fill(lambda m, n: np.zeros((ts, ts), np.float32))
+    return A
+
+
+def _run(prog, nt, kt, colls, check=None):
+    """One instantiation + drain on a fresh context; returns wall_s."""
+    import parsec_tpu as pt
+    ctx = pt.Context(nb_cores=1)
+    try:
+        mats = {k: _mk(k, nt) for k in colls}
+        t0 = time.perf_counter()
+        tp = prog.instantiate(ctx, globals={"NT": nt, "KT": kt},
+                              collections=mats)
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=300)
+        wall = time.perf_counter() - t0
+        assert tp._ptexec_state is not None, "pool fell off the lane"
+        if check is not None:
+            check(mats)
+        return wall
+    finally:
+        ctx.fini()
+
+
+def _check_mix(kt):
+    def check(mats):
+        h = float(np.asarray(
+            mats["descH"].data_of(0, 0).newest_copy().payload)[0, 0])
+        d = float(np.asarray(
+            mats["descD"].data_of(0, 0).newest_copy().payload)[0, 0])
+        assert h == float(kt) and d == float(2 * kt), (h, d)
+    return check
+
+
+def placement_leg(out, reps=4, nt=8, kt=32):
+    """static wall (placement knob off) vs adaptive wall (model warmed
+    to convergence). Returns the per-(class, device) exercised pairs."""
+    from parsec_tpu import native as native_mod
+    from parsec_tpu.core import costmodel
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    from parsec_tpu.utils import mca
+
+    if native_mod.load_ptdev() is None:
+        out["placement_note"] = "native _ptdev unavailable: leg skipped"
+        return None
+    prog = compile_ptg(_MIX_SRC, "ab-mix")
+    colls = ("descH", "descD")
+    check = _check_mix(kt)
+    mca.set("device_tpu_over_cpu", True)
+    mca.set("region_fusion", False)      # isolate placement from fusion
+    try:
+        # static: the has-a-device-body heuristic, model still learning
+        # (one untimed run first: both bodies jit-compile cold exactly
+        # once per process, and that must land in neither timed leg)
+        mca.set("costmodel_placement", False)
+        try:
+            _run(prog, nt, kt, colls, check)
+            static_s = min(_run(prog, nt, kt, colls, check)
+                           for _ in range(reps))
+        finally:
+            mca.params.unset("costmodel_placement")
+        # adaptive: converge (measure tpu → explore cpu → both measured),
+        # then time the steady state
+        for _ in range(2):
+            _run(prog, nt, kt, colls, check)
+        adaptive_s = min(_run(prog, nt, kt, colls, check)
+                         for _ in range(reps))
+        out["placement_static_ms"] = round(static_s * 1e3, 1)
+        out["placement_adaptive_ms"] = round(adaptive_s * 1e3, 1)
+        out["adaptive_vs_static_placement_ratio"] = round(
+            static_s / adaptive_s, 3)
+        bucket = costmodel.shape_bucket(8 * 8 * 4)
+        return [("ab-mix.H", bucket, "cpu"), ("ab-mix.D", bucket, "tpu"),
+                ("ab-mix.D", bucket, "cpu")]
+    finally:
+        mca.params.unset("region_fusion")
+        mca.params.unset("device_tpu_over_cpu")
+
+
+def fusion_leg(out, reps=4, nt=48, kt=32):
+    """static-knob fusion wall vs model-sized wall on the many-tiny-
+    regions DAG, both warm."""
+    from parsec_tpu.dsl.ptg.compiler import compile_ptg
+    from parsec_tpu.utils import mca
+
+    prog = compile_ptg(_CHAIN_SRC, "ab-chain")
+    colls = ("descH",)
+    # warm-up: cold fused (region traces measured), warm fused (fused
+    # dispatch measured), unfused (per-task dispatch measured)
+    mca.set("costmodel_fusion", False)
+    try:
+        _run(prog, nt, kt, colls)            # cold: traces
+        static_s = min(_run(prog, nt, kt, colls) for _ in range(reps))
+        mca.set("region_fusion", False)
+        try:
+            _run(prog, nt, kt, colls)        # unfused: per-task cost
+        finally:
+            mca.params.unset("region_fusion")
+    finally:
+        mca.params.unset("costmodel_fusion")
+    _run(prog, nt, kt, colls)                # adaptive warm-up (re-plan)
+    adaptive_s = min(_run(prog, nt, kt, colls) for _ in range(reps))
+    out["fusion_static_ms"] = round(static_s * 1e3, 1)
+    out["fusion_adaptive_ms"] = round(adaptive_s * 1e3, 1)
+    out["fusion_sizing_speedup"] = round(static_s / adaptive_s, 3)
+
+
+def bench() -> None:
+    from parsec_tpu.core.costmodel import COSTMODEL_STATS
+
+    out = {"metric": "adaptive", "unit": "ratio"}
+    snap = COSTMODEL_STATS.snapshot()
+    t0 = time.perf_counter()
+    try:
+        placement_leg(out)
+    except Exception as e:  # noqa: BLE001 — degrade, keep other legs
+        out["placement_error"] = str(e)[:300]
+    try:
+        fusion_leg(out)
+    except Exception as e:  # noqa: BLE001 — degrade-and-continue
+        out["fusion_error"] = str(e)[:300]
+    total_ns = (time.perf_counter() - t0) * 1e9
+    d = COSTMODEL_STATS.delta(snap)
+    out["costmodel_decision_overhead_pct"] = round(
+        d["decision_ns"] / max(total_ns, 1.0) * 100.0, 4)
+    out["costmodel_decisions"] = d["decisions"]
+    out["placements_diverged"] = d["placements_diverged"]
+    out["fusion_sized"] = d["fusion_sized"]
+    out["value"] = out.get("adaptive_vs_static_placement_ratio", 0.0)
+    # every leg above runs on the XLA-CPU proxy host: the device lane
+    # it measures against is a host artifact, so the RATIOS are the
+    # regression signals, not accelerator numbers
+    out["cpu_artifact"] = True
+    print(json.dumps(out))
+
+
+def ci_gate() -> None:
+    """ci.sh adaptive-engagement gate: the measurement→decision loop
+    demonstrably closed, the overhead contract held, nothing fell back."""
+    from parsec_tpu import native as native_mod
+    from parsec_tpu.core import costmodel
+    from parsec_tpu.core.costmodel import COSTMODEL_STATS
+    from parsec_tpu.device.native import PTDEV_STATS
+    from parsec_tpu.dsl.ptg.compiler import PTEXEC_STATS
+
+    if native_mod.load_ptdev() is None:
+        print(json.dumps({"adaptive_gate": "SKIP",
+                          "reason": "native _ptdev unavailable"}))
+        return
+    out = {}
+    snap = COSTMODEL_STATS.snapshot()
+    psnap = PTEXEC_STATS.snapshot()
+    dsnap = PTDEV_STATS.snapshot()
+    t0 = time.perf_counter()
+    pairs = placement_leg(out, reps=2)
+    fusion_leg(out, reps=2)
+    total_ns = (time.perf_counter() - t0) * 1e9
+    d = COSTMODEL_STATS.delta(snap)
+    # 1. the loop closed: every exercised (class, device) pair has a
+    # nonzero measured cost
+    assert pairs is not None, "placement leg did not run"
+    for cls, bucket, dev in pairs:
+        c = costmodel.model.count(cls, bucket, dev)
+        assert c > 0, f"cost model never fed for {(cls, bucket, dev)}"
+        cost = costmodel.model.cost(cls, bucket, dev)
+        assert cost is not None and cost > 0, \
+            f"zero cost for {(cls, bucket, dev)}"
+    # 2. measurement overrode the static heuristic at least once
+    assert d["placements_adaptive"] >= 1, d
+    assert d["placements_diverged"] >= 1, \
+        f"adaptive placement never diverged from the heuristic: {d}"
+    # 3. fusion sizing engaged on the measurements
+    assert d["fusion_sized"] >= 1, \
+        f"fusion sizing never used the model: {d}"
+    # 4. the <1% decision-overhead contract
+    overhead = d["decision_ns"] / max(total_ns, 1.0) * 100.0
+    assert overhead < 1.0, \
+        f"decision overhead {overhead:.3f}% breaks the <1% contract"
+    # 5. nothing fell back off the lanes while adapting
+    assert PTEXEC_STATS.delta(psnap)["pools_fallback"] == 0
+    assert PTDEV_STATS.delta(dsnap)["pools_fallback"] == 0
+    out["adaptive_gate"] = "OK"
+    out["decision_overhead_pct"] = round(overhead, 4)
+    out["placements_diverged"] = d["placements_diverged"]
+    out["fusion_sized"] = d["fusion_sized"]
+    out["keys"] = d["keys"]
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--ci-gate" in sys.argv:
+        ci_gate()
+    else:
+        bench()

@@ -133,6 +133,27 @@ echo "== region fusion + warm-pool engagement smoke =="
 # measurably cheaper (warm) instantiation. Engagement, not throughput.
 JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/fusion_bench.py --ci-gate
 
+echo "== adaptive runtime engagement smoke (online cost models) =="
+# ISSUE 18: the measurement->decision loop must demonstrably close —
+# cost models nonzero for every exercised (class, device) pair, >= 1
+# placement decision DIVERGING from the static has-a-device-body
+# heuristic on a heterogeneous mixed DAG (the host device lane is pure
+# overhead for tiny tasks, and honest measurement must say so), fusion
+# sizing consulting the measured break-even, the <1% decision-overhead
+# contract, and ZERO pools_fallback while adapting
+JAX_PLATFORMS=cpu timeout 300 python3 benchmarks/adaptive_bench.py --ci-gate
+
+echo "== multi-backend device lane smoke (cuda, when present) =="
+# the device lane must not be TPU-shaped by accident: when this host has
+# a CUDA backend, the same ptdev gate must pass under JAX_PLATFORMS=cuda
+# (real accelerator, real transfers). Skipped WITH ATTRIBUTION otherwise
+# — a silent skip would read as coverage
+if python3 -c "import jax; assert any(d.platform == 'gpu' for d in jax.devices('cuda'))" 2>/dev/null; then
+    JAX_PLATFORMS=cuda timeout 300 python3 benchmarks/zone_bench.py --ci-gate
+else
+    echo "SKIP: no CUDA backend on this host (jax.devices('cuda') empty/unavailable); device-lane gate ran CPU-only above"
+fi
+
 echo "== cross-rank serving fabric engagement smoke (ptfab, 2 ranks) =="
 # ISSUE 11: credit grants/spends must be nonzero ON THE WIRE with zero
 # frame errors (spends local — frames don't scale with spends), remote

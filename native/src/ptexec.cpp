@@ -157,6 +157,19 @@ struct Graph {
     std::vector<int32_t> *weight;     // per node; empty = all 1
     bool weighted;
     int64_t w_total;                  // sum(weight) — the done() target
+    // cost-model rows (cost_bind, ISSUE 18): per-node row ids into a
+    // (count, sum_ns) accumulator pair. The rows ride the SAME batch-
+    // amortized clock reads as the exec_ns histogram bump — when bound,
+    // each executed task adds the per-task batch cost into its row with
+    // two relaxed atomics; nothing new touches the clock. Rows group
+    // tasks by (class, shape bucket, device flavor); the Python side
+    // keeps the row -> key metadata and folds snapshots into the online
+    // cost model at the histogram registry's detach points. -1 = node
+    // not attributed (no extra cost for it beyond the row load).
+    std::vector<int32_t> *cost_rows;  // per node row id; empty = unbound
+    std::atomic<uint64_t> *cost_cnt;  // per row: tasks accumulated
+    std::atomic<uint64_t> *cost_sum;  // per row: summed amortized ns
+    int32_t n_cost_rows;
     // scheduler plane binding (sched_bind, ISSUE 9): when set, the ready
     // structure lives in the shared multi-pool plane (pool `spool`) — N
     // concurrent lane graphs then share the workers by DRR weight instead
@@ -382,6 +395,10 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
     self->weight = new (std::nothrow) std::vector<int32_t>();
     self->weighted = false;
     self->w_total = 0;
+    self->cost_rows = new (std::nothrow) std::vector<int32_t>();
+    self->cost_cnt = nullptr;
+    self->cost_sum = nullptr;
+    self->n_cost_rows = 0;
     self->splane = nullptr;
     self->spool = -1;
     self->sched_cap = nullptr;
@@ -389,7 +406,8 @@ PyObject *graph_new(PyTypeObject *type, PyObject *args, PyObject *) {
         !self->ready || !self->mu || !self->prio || !self->in_off ||
         !self->in_slots || !self->slot_uses || !self->retired ||
         !self->owners || !self->rdv_pending || !self->parked ||
-        !self->dev_mask || !self->dev_ret || !self->weight) {
+        !self->dev_mask || !self->dev_ret || !self->weight ||
+        !self->cost_rows) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return nullptr;
@@ -562,6 +580,9 @@ void graph_dealloc(PyObject *obj) {
     delete self->dev_mask;
     delete self->dev_ret;
     delete self->weight;
+    delete self->cost_rows;
+    delete[] self->cost_cnt;
+    delete[] self->cost_sum;
     delete[] self->counts;
     delete[] self->slot_cnt;
     delete[] self->ready_stamp;
@@ -648,6 +669,11 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
     // state degrades to the same null branch as never-enabled
     pthist::State<N_HISTS> *hs = self->hist.load(std::memory_order_acquire);
     if (hs && !hs->enabled.load(std::memory_order_relaxed)) hs = nullptr;
+    // cost-model rows: when bound, the exec bump's amortized per-task
+    // cost also lands in the per-row accumulators (cost_bind precedes
+    // run() on the enqueue path, so no mid-run race on the vector)
+    const int32_t *crow =
+        self->cost_rows->empty() ? nullptr : self->cost_rows->data();
     int64_t h_t0 = 0;
     PyThreadState *ts = PyEval_SaveThread();   // GIL dropped for the walk
     for (;;) {
@@ -717,16 +743,21 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             }
         }
         if (stop) break;
-        if (hs) {
+        if (hs || crow) {
             // ready-queue wait (sampled): pop time minus the stamped
             // push time; unstamped ids (armed mid-flight) are skipped.
-            // One clock read per batch — reused as the exec-latency start
+            // One clock read per batch — reused as the exec-latency
+            // start, and (ISSUE 18) as the cost-row batch start: the
+            // cost model rides the histogram's clock reads, it never
+            // adds its own
             int64_t now = ptrace_ring::now_ns();
-            for (int32_t t : local) {
-                if (!hist_sampled(t)) continue;
-                int64_t s0 =
-                    self->ready_stamp[t].load(std::memory_order_relaxed);
-                if (s0 > 0) hs->h[H_READY].add(now - s0);
+            if (hs) {
+                for (int32_t t : local) {
+                    if (!hist_sampled(t)) continue;
+                    int64_t s0 =
+                        self->ready_stamp[t].load(std::memory_order_relaxed);
+                    if (s0 > 0) hs->h[H_READY].add(now - s0);
+                }
             }
             h_t0 = now;
         }
@@ -882,7 +913,7 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             spl->push(self->spool, wid, fresh.data(),
                       gather_prios(self, fresh, fprio),
                       (int)fresh.size());
-        if (hs && !local.empty()) {
+        if ((hs || crow) && !local.empty()) {
             // per-task execute latency, batch-amortized: the whole
             // dispatch + release sweep cost divided across the batch,
             // bumped once with the batch count — two clock reads and
@@ -891,7 +922,25 @@ PyObject *graph_run(PyObject *obj, PyObject *args) {
             // ORIGINAL-task denominated on fused pools, like every
             // other counter in this sweep
             int64_t per = (ptrace_ring::now_ns() - h_t0) / batch_w;
-            hs->h[H_EXEC].add(per, (uint64_t)batch_w);
+            if (hs) hs->h[H_EXEC].add(per, (uint64_t)batch_w);
+            if (crow) {
+                // cost rows (ISSUE 18): the same amortized cost, split
+                // by the compiler's (class, bucket, device) rows — two
+                // relaxed atomics per task, no extra clock reads. The
+                // weight keeps fused nodes original-task denominated,
+                // matching the histogram and w_total accounting.
+                const int32_t *wts =
+                    self->weighted ? self->weight->data() : nullptr;
+                for (int32_t t : local) {
+                    int32_t r = crow[t];
+                    if (r < 0) continue;
+                    uint64_t w = wts ? (uint64_t)wts[t] : 1;
+                    self->cost_cnt[r].fetch_add(w,
+                                                std::memory_order_relaxed);
+                    self->cost_sum[r].fetch_add((uint64_t)per * w,
+                                                std::memory_order_relaxed);
+                }
+            }
         }
         mine += batch_w;
         local.clear();
@@ -1331,6 +1380,85 @@ PyObject *graph_region_stats(PyObject *obj, PyObject *) {
                                                      : self->n_local));
 }
 
+// cost_bind(rows) — attach cost-model rows (ISSUE 18): rows[i] is the
+// accumulator row task i reports into (-1 = unattributed). The compiler
+// assigns one row per (class, shape bucket, device flavor) and keeps the
+// row -> key metadata Python-side; run()'s exec bump then splits its
+// batch-amortized per-task cost across the rows at two relaxed atomics
+// per task. Bind before enqueue (the lane does) — run() snapshots the
+// row pointer once per call.
+PyObject *graph_cost_bind(PyObject *obj, PyObject *arg) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    std::vector<int32_t> rows;
+    if (!parse_i32_list(arg, rows, "rows: sequence of ints"))
+        return nullptr;
+    if ((int64_t)rows.size() != self->n) {
+        PyErr_SetString(PyExc_ValueError, "rows must have n entries");
+        return nullptr;
+    }
+    int32_t nrows = 0;
+    for (int32_t r : rows) {
+        if (r < -1) {
+            PyErr_SetString(PyExc_ValueError, "row ids must be >= -1");
+            return nullptr;
+        }
+        if (r >= nrows) nrows = r + 1;
+    }
+    std::lock_guard<std::mutex> lk(*self->mu);
+    if (self->running > 0) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "cost_bind() on a graph already running");
+        return nullptr;
+    }
+    delete[] self->cost_cnt;
+    delete[] self->cost_sum;
+    self->cost_cnt = nullptr;
+    self->cost_sum = nullptr;
+    if (nrows > 0) {
+        self->cost_cnt = new (std::nothrow) std::atomic<uint64_t>[nrows];
+        self->cost_sum = new (std::nothrow) std::atomic<uint64_t>[nrows];
+        if (!self->cost_cnt || !self->cost_sum) {
+            delete[] self->cost_cnt;
+            delete[] self->cost_sum;
+            self->cost_cnt = nullptr;
+            self->cost_sum = nullptr;
+            PyErr_NoMemory();
+            return nullptr;
+        }
+        for (int32_t r = 0; r < nrows; r++) {
+            self->cost_cnt[r].store(0, std::memory_order_relaxed);
+            self->cost_sum[r].store(0, std::memory_order_relaxed);
+        }
+    }
+    *self->cost_rows = std::move(rows);
+    self->n_cost_rows = nrows;
+    return PyLong_FromLong((long)nrows);
+}
+
+// cost_snapshot() -> [(count, sum_ns)] per row — drained by the Python
+// fold at the histogram registry's detach points. Relaxed reads: a
+// concurrent bump may straddle the snapshot, but folds only run once
+// the lane's graph is done (or abandoned), so the pairs are settled.
+PyObject *graph_cost_snapshot(PyObject *obj, PyObject *) {
+    Graph *self = reinterpret_cast<Graph *>(obj);
+    PyObject *out = PyList_New((Py_ssize_t)self->n_cost_rows);
+    if (!out) return nullptr;
+    for (int32_t r = 0; r < self->n_cost_rows; r++) {
+        PyObject *pair = Py_BuildValue(
+            "(KK)",
+            (unsigned long long)self->cost_cnt[r].load(
+                std::memory_order_relaxed),
+            (unsigned long long)self->cost_sum[r].load(
+                std::memory_order_relaxed));
+        if (!pair) {
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, (Py_ssize_t)r, pair);
+    }
+    return out;
+}
+
 // trace_mark(key, id, flags) — record one event into this graph's rings
 // from Python (GIL held). The region dispatch wrappers bracket each
 // fused-region body with EV_REGION START/END so merged Perfetto
@@ -1617,6 +1745,13 @@ PyMethodDef graph_methods[] = {
      "and run() become original-task denominated"},
     {"region_stats", graph_region_stats, METH_NOARGS,
      "{fused_regions, fused_tasks, nodes, weighted_total}"},
+    {"cost_bind", graph_cost_bind, METH_O,
+     "cost_bind(rows) -> n_rows: attach per-(class, bucket, device) "
+     "cost-model rows (-1 = unattributed); run()'s batch-amortized exec "
+     "bump splits its cost across the rows (ISSUE 18)"},
+    {"cost_snapshot", graph_cost_snapshot, METH_NOARGS,
+     "cost_snapshot() -> [(count, sum_ns)] per row — folded into the "
+     "online cost model at lane detach"},
     {"trace_mark", graph_trace_mark, METH_VARARGS,
      "trace_mark(key, id, flags): record one ring event from Python "
      "(EV_REGION dispatch intervals of the fused-region wrappers)"},

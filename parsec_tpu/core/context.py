@@ -309,6 +309,28 @@ class Context:
             from ..utils.hist import histograms
             histograms.detach(obj)
 
+    # ----------------------------------------------------- online cost model
+    def _cost_fold(self, lane: Dict[str, Any]) -> None:
+        """Fold a finishing lane's cost observations into the online cost
+        model (ISSUE 18) — the SAME lifecycle moment as the histogram
+        registry's detach, and idempotent the same way the abandon path
+        needs: every exiting stream of an errored graph attempts this,
+        the pop()s make only the first one fold."""
+        meta = lane.pop("cost_meta", None)
+        obs = lane.pop("cost_dev", None)
+        if meta is None and not obs:
+            return
+        from .costmodel import fold_cost_rows, model
+        if meta is not None:
+            try:
+                fold_cost_rows(meta, lane["graph"].cost_snapshot())
+            except Exception:  # noqa: BLE001 — folding is advisory
+                pass
+        if obs:
+            # the device lane's dispatch/poll observations (manager-thread
+            # local dict: (cls, bucket, dev) -> [count, sum_ns])
+            model.fold_pairs((k, v[0], v[1]) for k, v in obs.items())
+
     def register_drain_hook(self, bound_method) -> None:
         import weakref
         self._drain_hooks.append(weakref.WeakMethod(bound_method))
@@ -491,6 +513,11 @@ class Context:
         if self.sched_plane is not None:
             # same lifecycle for the plane's queue-wait histogram
             self._hist_detach(self.sched_plane.plane)
+        # persist the online cost model (ISSUE 18) alongside the warm-
+        # executable cache's lifecycle: a restarted serving process loads
+        # it back at its first placement decision and starts warm
+        from .costmodel import model as _cost_model
+        _cost_model.maybe_save()
         if self.metrics is not None:
             # endpoint down LAST: ops dashboards may scrape through the
             # drain, and the fini counter aggregation itself is scrapeable
@@ -734,6 +761,7 @@ class Context:
                 # events and stop pinning it
                 self._ntrace_detach(lane["graph"])
                 self._hist_detach(lane["graph"])
+                self._cost_fold(lane)
                 self._sched_pool_retire(lane)
             return True
         return mine > 0
@@ -810,6 +838,7 @@ class Context:
         taskpool's remaining lifetime."""
         self._ntrace_detach(lane["graph"])   # final drain of an errored lane
         self._hist_detach(lane["graph"])
+        self._cost_fold(lane)                # idempotent (pop-guarded)
         self._sched_pool_retire(lane)        # free the plane pool slot
         if lane.get("dev_pool") is not None:
             # stop routing the poisoned pool's device completions (in-

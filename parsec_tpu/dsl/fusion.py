@@ -140,6 +140,89 @@ class ExecCache:
             self._d.clear()
 
 
+def adaptive_fusion_limits(classes: Sequence[Tuple[str, int, str]],
+                           ) -> Tuple[set, int, int]:
+    """Consumer (b) of the online cost model (ISSUE 18): size the fusion
+    pass by MEASUREMENT instead of the static knobs.
+
+    ``classes`` lists each capturable class as ``(name, shape_bucket,
+    device_key)`` ('cpu' or 'tpu' — the fused flavor is looked up as
+    ``<key>_fused``). Returns ``(declined, min_size, max_size)``:
+
+    * ``declined`` — class indices to UN-fuse: the model has measured
+      both flavors and the fused per-task cost (which prices in the
+      in-dispatch re-trace a shape-churning workload pays N-bodies-wide
+      per region) meets or exceeds the unfused per-task dispatch cost —
+      fusion's premise ("dispatch overhead exceeds the region's marginal
+      compiled-dispatch cost") measurably fails for that class.
+    * ``max_size`` — the measured break-even region cap: the largest
+      power-of-two band whose per-member trace cost (the
+      ``__region_trace__`` pseudo-class, fed by the compiler timing each
+      region program's first call), amortized by the executable cache's
+      measured reuse ratio, stays below the measured per-task dispatch
+      saving. Replaces the static ``region_fusion_max`` ceiling — the
+      static knob stays the hard upper bound (the compile-blowup escape
+      hatch is not negotiable), the model only ever splits SOONER.
+
+    ``min_size`` stays the static knob: the fuse-at-all break-even is
+    per-class (handled by ``declined``), not size-dependent once the
+    batch amortization is in effect. With the model disabled or cold
+    this degrades to exactly the static limits — instantiation never
+    blocks on measurement."""
+    min_size = int(mca.get("region_fusion_min", 2))
+    max_size = int(mca.get("region_fusion_max", 128))
+    declined: set = set()
+    from ..core import costmodel as _cm     # lazy: utils-only module deps
+    if not (_cm.enabled() and mca.get("costmodel_fusion", True)):
+        return declined, min_size, max_size
+    m = _cm.model
+    saving = None                # measured per-task dispatch cost avoided
+    for ci, (name, bucket, dev) in enumerate(classes):
+        if not m.measured(name, bucket, dev):
+            continue
+        unfused = m.cost(name, bucket, dev)
+        if m.measured(name, bucket, dev + "_fused") and \
+                m.cost(name, bucket, dev + "_fused") >= unfused:
+            declined.add(ci)
+            _cm.COSTMODEL_STATS["fusion_declined"] += 1
+            continue
+        if saving is None or unfused < saving:
+            saving = unfused     # conservative: the cheapest class bounds
+                                 # what fusion can save per member
+    sized = False
+    if saving is not None and saving > 0:
+        # the break-even comparison RAN on real measurements — a model-
+        # derived sizing decision even when it confirms the static cap
+        sized = True
+        hits = CAPTURE_CACHE_STATS["cache_hits"]
+        total = hits + CAPTURE_CACHE_STATS["cache_misses"]
+        reuse = (hits / total) if total else 0.0
+        cap = max_size
+        while cap > min_size:
+            per_member = m.region_trace_ns("cpu", cap)
+            if per_member is None or per_member * (1.0 - reuse) <= saving:
+                break            # unmeasured band: trust the static knob
+            # halve only when the model has MEASURED the smaller band
+            # cheaper per member: trace cost has a fixed per-program
+            # floor, so splitting a region doubles the programs and can
+            # RAISE total trace time — without a measured win the split
+            # is speculation, and a speculative split re-plans the pool
+            # (new flatten key → every region re-traces cold), the exact
+            # oscillation this guard exists to prevent
+            band = max(0, (cap // 2).bit_length() - 1)
+            if not m.measured(_cm.REGION_TRACE, band, "cpu"):
+                break
+            half = m.region_trace_ns("cpu", cap // 2)
+            if half is None or half >= per_member:
+                break
+            cap //= 2
+        if cap != max_size:
+            max_size = max(cap, min_size)
+    if declined or sized:
+        _cm.COSTMODEL_STATS["fusion_sized"] += 1
+    return declined, min_size, max_size
+
+
 def topo_order(n: int, off: Sequence[int], succs: Sequence[int]) -> List[int]:
     """Kahn topological order of a CSR DAG (the flatten output is a DAG
     by construction: indeg == goals was validated)."""

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import textwrap
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -69,7 +70,9 @@ mca.register("ptg_native_exec", True,
 #: failure. utils/counters.install_native_counters exports these under
 #: ``ptexec.*`` for live_view and the SDE-style snapshot
 from ...utils.counters import LaneStats as _LaneStats
-from ..fusion import ExecCache, device_fingerprint, partition_regions
+from ..fusion import (
+    ExecCache, adaptive_fusion_limits, device_fingerprint, partition_regions,
+)
 
 PTEXEC_STATS = _LaneStats(pools_engaged=0, tasks_engaged=0,
                           pools_fallback=0, pools_ineligible=0,
@@ -137,6 +140,29 @@ def _index_expr(src: str):
         elif c == "." and depth == 0 and src[i:i+2] == ".." and src[i:i+3] != "...":
             return _RangeExpr(src[:i], src[i+2:])
     return _Expr(src)
+
+
+def _timed_region_program(fn, n_members: int):
+    """Wrap a jitted region program so its FIRST call — the one paying
+    the XLA trace+compile — feeds the cost model's ``__region_trace__``
+    pseudo-class (per-member cost by region-size band; ISSUE 18). The
+    wrapper, not the bare jit, is what the executable cache stores: a
+    warm cache hit reuses it with the first call already burned, so only
+    real traces are ever observed. Steady-state calls pay one dict-free
+    boolean check."""
+    state = [True]
+
+    def call(ev):
+        if state[0]:
+            state[0] = False
+            t0 = time.perf_counter_ns()
+            out = fn(ev)
+            from ...core.costmodel import model
+            model.note_region_trace("cpu", n_members,
+                                    time.perf_counter_ns() - t0)
+            return out
+        return fn(ev)
+    return call
 
 
 def _mk_region_program(rp: Dict[str, Any], fns, written_by_class):
@@ -945,16 +971,17 @@ class PTGTaskpool(Taskpool):
             return False
         if len(tc._ptg_spec.bodies) != 1:
             return False
-        if self._ptexec_class_device(tc):
-            if tc.time_estimate is not None:
-                # a user ETA hook feeds best-device selection — machinery
-                # the lane bypasses; calling (or silently not calling) a
-                # user hook is observable behavior (the make_key_fn rule)
+        if not self._ptexec_class_device(tc):
+            # (a device class with a user `time_estimate` hook used to
+            # decline here — the PR 10 carve-out. ISSUE 18 erased it: the
+            # lane now CALLS the hook at the instantiation boundary to
+            # seed the cost model's cold-start prior, restoring the
+            # best-device semantics natively instead of falling back to
+            # the interpreted FSM. See _ptexec_seed_prior.)
+            if len(tc.incarnations) != 1 or \
+                    tc.incarnations[0].device_type != DEV_CPU or \
+                    tc.incarnations[0].evaluate is not None:
                 return False
-        elif len(tc.incarnations) != 1 or \
-                tc.incarnations[0].device_type != DEV_CPU or \
-                tc.incarnations[0].evaluate is not None:
-            return False
         has_body = tc._ptg_spec.bodies[0].source.strip() not in ("", "pass")
         if not any(not (f.access & FLOW_ACCESS_CTL) for f in tc.flows):
             # CTL/flowless: non-empty bodies dispatch through the raw-body
@@ -1187,6 +1214,172 @@ class PTGTaskpool(Taskpool):
             }
         return flat
 
+    # ------------------------------------------ online cost model (ISSUE 18)
+    def _ptexec_pool_bucket(self) -> int:
+        """The pool's shape bucket: the log4 byte-size bucket of its
+        largest tile (TiledMatrix mb*nb*itemsize over the bound
+        collections). Pools whose tiles sit within 4x of each other —
+        one cost regime — share cost-model keys; collection-less pools
+        key at bucket 0."""
+        from ...core.costmodel import shape_bucket
+        nbytes = 0
+        for dc in self.collections.values():
+            mb = getattr(dc, "mb", None)
+            nb = getattr(dc, "nb", None)
+            if not mb or not nb:
+                continue
+            try:
+                item = np.dtype(getattr(dc, "dtype", np.float32)).itemsize
+            except TypeError:
+                item = 4
+            nbytes = max(nbytes, int(mb) * int(nb) * item)
+        return shape_bucket(nbytes)
+
+    def _ptexec_seed_prior(self, tc: TaskClass, name: str,
+                           bucket: int) -> None:
+        """Fold a user ``time_estimate`` hook into the cost model as the
+        class's cold-start prior (ISSUE 18 — the PR 10 carve-out,
+        inverted): call the hook once per device flavor with a
+        representative task (`make_task` is side-effect free) and the
+        real device modules — the observable calling convention the
+        interpreted best-device path used — and install the answers (in
+        seconds, like the reference's ETA vtable) as priors. Measured
+        costs override the prior as soon as the key warms up."""
+        est = tc.time_estimate
+        if est is None:
+            return
+        from ...core.costmodel import model
+        try:
+            loc = next(iter(self._enum_class(tc)))
+        except StopIteration:
+            return
+        task = self.ctx.make_task(self, tc, loc)
+        tpus = self.ctx.devices.by_type(DEV_TPU)
+        for dev_obj, key in ((self.ctx.devices.cpu, "cpu"),
+                             (tpus[0] if tpus else None, "tpu")):
+            if dev_obj is None:
+                continue
+            try:
+                eta = float(est(task, dev_obj))
+            except Exception:  # noqa: BLE001 — a hook error never ejects
+                continue       # the pool from the lane (the old behavior
+                               # it replaces was a flat decline)
+            model.seed_prior(name, bucket, key, eta * 1e9)
+
+    def _ptexec_place_classes(self, classes: List[TaskClass],
+                              dev_classes: List[bool],
+                              names: Tuple[str, ...],
+                              bucket: int) -> List[bool]:
+        """Consumer (a) of the online cost model: per-instantiation
+        best-device selection for the pool's TPU-bodied classes (each
+        has a CPU twin of the same jitted function — the placement is
+        free to move the whole class either way).
+
+        Decision ladder per class, most-informed first: both flavors
+        MEASURED → cheaper wins, with the device side carrying its
+        measured stage-in cost pro-rated by the observed stage-in/task
+        ratio (the coherency table's hit rate prices itself in); one
+        flavor measured → explore the cold twin ONCE (the model cannot
+        compare costs it never collected); neither measured → compare
+        the user-hook priors when both were seeded, else the static
+        has-a-device-body heuristic. Runs at the instantiation boundary
+        only — its wall time lands in ``costmodel.decision_ns`` (the
+        <1% contract's numerator)."""
+        from ...core import costmodel as _cm
+        if not (_cm.enabled() and mca.get("costmodel_placement", True)):
+            return list(dev_classes)
+        m = _cm.model
+        m.maybe_load()
+        t0 = time.perf_counter_ns()
+        stats = _cm.COSTMODEL_STATS
+        out: List[bool] = []
+        for ci, tc in enumerate(classes):
+            if not dev_classes[ci]:
+                out.append(False)
+                continue
+            name = names[ci]
+            self._ptexec_seed_prior(tc, name, bucket)
+            cpu_known = m.measured(name, bucket, "cpu")
+            tpu_known = m.measured(name, bucket, "tpu")
+            if cpu_known and tpu_known:
+                tpu_ns = m.cost(name, bucket, "tpu")
+                st = m.cost(_cm.STAGE_IN, bucket, "tpu")
+                if st is not None:
+                    n_st = m.count(_cm.STAGE_IN, bucket, "tpu")
+                    n_tpu = max(1, m.count(name, bucket, "tpu"))
+                    tpu_ns += st * min(1.0, n_st / n_tpu)
+                choice = tpu_ns <= m.cost(name, bucket, "cpu")
+            elif tpu_known:
+                choice = not m.begin_explore(name, bucket, "cpu")
+            elif cpu_known:
+                choice = m.begin_explore(name, bucket, "tpu")
+            else:
+                pc = m.cost(name, bucket, "cpu")
+                pt = m.cost(name, bucket, "tpu")
+                choice = (pt <= pc) if (pc is not None and pt is not None) \
+                    else True
+            out.append(choice)
+            stats["placements_adaptive"] += 1
+            if choice != dev_classes[ci]:
+                stats["placements_diverged"] += 1
+        stats["decisions"] += 1
+        stats["decision_ns"] += time.perf_counter_ns() - t0
+        return out
+
+    def _ptexec_cost_bind(self, lane: Dict[str, Any], graph, flat,
+                          names: Tuple[str, ...], bucket: int,
+                          plan=None, cold_regions=None) -> None:
+        """Attach the C-side cost rows (ISSUE 18): one row per (class,
+        flavor), node-mapped so run()'s batch-amortized exec bump lands
+        each task's share in the right accumulator. Unfused tasks row at
+        their class index ('cpu'); fused region nodes row at n_classes +
+        first-member class ('cpu_fused' — a multi-class region is
+        attributed to its lead class; the capturable chains the fusion
+        pass emits are single-class in practice). Device-placed nodes
+        never pass the C bump site (they retire through the ptdev lane,
+        observed there) — their rows simply stay zero and the fold skips
+        them. The row → key metadata rides the lane dict to the fold at
+        detach (Context._cost_fold)."""
+        from ...core import costmodel as _cm
+        if not _cm.enabled():
+            return
+        ncls = len(names)
+        meta = [(names[ci], bucket, "cpu") for ci in range(ncls)] + \
+               [(names[ci], bucket, "cpu_fused") for ci in range(ncls)]
+        if plan is None:
+            cls_of = flat["data"]["cls_of"] if flat["data"] is not None \
+                else None
+            if cls_of is None:
+                rows = []
+                for ci, insts in enumerate(flat["params"]):
+                    rows.extend([ci] * len(insts))
+            else:
+                rows = list(cls_of)
+        else:
+            cls_of = flat["data"]["cls_of"]
+            rows = []
+            for nd in plan["node"]:
+                if nd[0] == "t":
+                    rows.append(cls_of[nd[1]])
+                elif cold_regions and nd[1] in cold_regions:
+                    # a COLD region (executable-cache miss): its first
+                    # dispatch pays the jit trace, and the C bump cannot
+                    # split that one batch out — so the whole run stays
+                    # unobserved (-1). Only warm instantiations feed the
+                    # <cls>_fused EWMA; the trace itself is measured
+                    # separately by _timed_region_program. Without this
+                    # a tiny cold DAG reads fusion as "slower than
+                    # unfused" forever and wrongly declines it.
+                    rows.append(-1)
+                else:
+                    members = plan["regions"][nd[1]]["members"]
+                    rows.append(ncls + cls_of[members[0]])
+        try:
+            graph.cost_bind(rows)
+        except Exception:  # noqa: BLE001 — an old native build without
+            return         # cost rows just leaves the model CPU-blind
+        lane["cost_meta"] = meta
+
     def _ptexec_prepare(self, agg) -> Optional[Dict[str, Any]]:
         """Build (or fetch from the program cache) the native-lane state
         for this pool, or None → the Python FSM runs as before. The fall
@@ -1249,6 +1442,27 @@ class PTGTaskpool(Taskpool):
         mod = native_mod.load_ptexec()
         if mod is None:
             return None
+        # consumer (a) of the online cost model (ISSUE 18): per-
+        # instantiation best-device selection. The static heuristic
+        # ("has a device body") is the cold-start fallback; once both
+        # flavors are measured the cheaper one wins, and a pool whose
+        # device classes ALL measure cheaper on their CPU twins skips
+        # the device lane entirely.
+        bucket = self._ptexec_pool_bucket()
+        # cost-model keys are qualified by the PROGRAM name: two programs
+        # are free to both name a class "A" with wildly different bodies,
+        # and the model must never blend their measurements (the taskpool
+        # name would work too, but the program name survives a caller
+        # passing per-instantiation pool names, keeping warm-cache and
+        # persisted entries addressable)
+        names = tuple(f"{self.program.spec.name}.{tc._ptg_spec.name}"
+                      for tc in classes)
+        place_dev = list(dev_classes)
+        if use_dev:
+            place_dev = self._ptexec_place_classes(classes, dev_classes,
+                                                   names, bucket)
+            if not any(place_dev):
+                use_dev = False
         devlane = None
         if use_dev:
             devlane = ctx._ptdev_lane()
@@ -1258,12 +1472,19 @@ class PTGTaskpool(Taskpool):
                 from ...device.native import PTDEV_STATS
                 PTDEV_STATS["pools_fallback"] += 1
                 return None
-        names = tuple(tc._ptg_spec.name for tc in classes)
+        # consumer (b): measured fusion limits (dsl/fusion.py). The
+        # decline set and the break-even cap shape the fusion plan, so
+        # they join the flatten cache key — a plan sized for one cost
+        # regime is never replayed under another.
+        fus_declined, fus_min, fus_max = adaptive_fusion_limits(
+            [(names[ci], bucket,
+              "tpu" if (use_dev and place_dev[ci]) else "cpu")
+             for ci in range(len(classes))])
         place = (ctx.nb_ranks, lane_comm is not None, use_dev,
                  device_fingerprint(),
                  bool(mca.get("region_fusion", True)),
-                 int(mca.get("region_fusion_min", 2)),
-                 int(mca.get("region_fusion_max", 128)))
+                 fus_min, fus_max,
+                 tuple(place_dev), tuple(sorted(fus_declined)))
         key = self._ptexec_cache_key(names, place)
         cache = self.program.__dict__.setdefault("_ptexec_cache", {})
         ent = cache.get(key) if key is not None else None
@@ -1276,8 +1497,9 @@ class PTGTaskpool(Taskpool):
                     and lane_comm is None:
                 # the fusion pass (ISSUE 12): single-rank data pools only
                 # — a fused region must never hide a cross-rank edge
-                plan = self._ptexec_fuse_plan(flat, classes, dev_classes,
-                                              use_dev)
+                plan = self._ptexec_fuse_plan(
+                    flat, classes, place_dev, use_dev,
+                    (fus_declined, fus_min, fus_max))
             ent = {"flat": flat, "fusion": plan}
             if key is not None:
                 cache[key] = ent
@@ -1311,6 +1533,7 @@ class PTGTaskpool(Taskpool):
                                                     flat["params"])
             lane = {"graph": graph, "callback": callback,
                     "n": flat["n"], "finalized": False}
+            self._ptexec_cost_bind(lane, graph, flat, names, bucket)
             if owners is not None:
                 self._ptexec_bind_comm(lane, lane_comm, owners)
             return lane
@@ -1322,7 +1545,8 @@ class PTGTaskpool(Taskpool):
         if ent.get("fusion") is not None and owners is None:
             return self._ptexec_lane_fused(flat, ent["fusion"], classes,
                                            mod, key,
-                                           devlane if use_dev else None)
+                                           devlane if use_dev else None,
+                                           place_dev, names, bucket)
         # data-flow pool: the graph additionally owns slot LIFETIMES (the
         # usagelmt/usagecnt retire protocol); Python owns slot VALUES —
         # one flat list the batched callback reads inputs from and lands
@@ -1360,6 +1584,7 @@ class PTGTaskpool(Taskpool):
             writebacks.setdefault(tid, []).append((dj, dc.data_of(*idx)))
         lane = {"graph": graph, "slots": slots,
                 "n": flat["n"], "finalized": False}
+        self._ptexec_cost_bind(lane, graph, flat, names, bucket)
         if owners is not None:
             self._ptexec_bind_comm(lane, lane_comm, owners)
         lane["callback"] = self._mk_ptexec_data_callback(
@@ -1372,13 +1597,15 @@ class PTGTaskpool(Taskpool):
             # this function returns — every closure it touches (slots,
             # mem_datas, writebacks) exists by now
             self._ptexec_bind_dev(lane, devlane, flat, classes,
-                                  dev_classes, slots, mem_datas, writebacks)
+                                  place_dev, slots, mem_datas, writebacks,
+                                  bucket)
         return lane
 
     # ---------------------------------------------- region fusion (ISSUE 12)
     def _ptexec_fuse_plan(self, flat, classes: List[TaskClass],
                           dev_classes: List[bool],
-                          use_dev: bool) -> Optional[Dict[str, Any]]:
+                          use_dev: bool,
+                          limits=None) -> Optional[Dict[str, Any]]:
         """The fusion pass over the flattened CSR: identify capturable
         subgraphs — same-device jittable bodies (the class's single
         jitted ``_ptg_body_fn``, or an empty forwarding body), static
@@ -1393,6 +1620,14 @@ class PTGTaskpool(Taskpool):
         — so the whole plan rides the flatten cache."""
         if not mca.get("region_fusion", True):
             return None
+        # measured fusion limits (ISSUE 18, dsl/fusion.py): the decline
+        # set un-fuses classes whose fused per-task cost measurably beats
+        # nothing; the cap is the measured break-even region size. Cold
+        # model → exactly the static knobs.
+        if limits is None:
+            limits = (set(), int(mca.get("region_fusion_min", 2)),
+                      int(mca.get("region_fusion_max", 128)))
+        fus_declined, fus_min, fus_max = limits
         data = flat["data"]
         n = flat["n"]
         cls_of = data["cls_of"]
@@ -1400,8 +1635,9 @@ class PTGTaskpool(Taskpool):
         # per-class capturability kind: None = seam (un-fusable)
         kind_by_class: List[Optional[str]] = []
         for ci, tc in enumerate(classes):
-            if ndflows[ci] == 0:
-                # CTL/flowless classes run raw Python bodies — seams
+            if ndflows[ci] == 0 or ci in fus_declined:
+                # CTL/flowless classes run raw Python bodies — seams;
+                # model-declined classes stay per-task by measurement
                 kind_by_class.append(None)
                 continue
             empty = tc._ptg_spec.bodies[0].source.strip() in ("", "pass")
@@ -1432,9 +1668,7 @@ class PTGTaskpool(Taskpool):
                         break
             kind.append(k)
         regions = partition_regions(
-            n, flat["off"], flat["succs"], kind,
-            int(mca.get("region_fusion_min", 2)),
-            int(mca.get("region_fusion_max", 128)))
+            n, flat["off"], flat["succs"], kind, fus_min, fus_max)
         if not regions:
             return None
         off, succs = flat["off"], flat["succs"]
@@ -1676,7 +1910,9 @@ class PTGTaskpool(Taskpool):
         return run_region
 
     def _ptexec_lane_fused(self, flat, plan, classes: List[TaskClass],
-                           mod, ckey, devlane) -> Dict[str, Any]:
+                           mod, ckey, devlane, place_dev: List[bool],
+                           names: Tuple[str, ...],
+                           bucket: int) -> Dict[str, Any]:
         """Build the native-lane state for a pool with a fusion plan:
         the compact graph (regions + seams) with original-task weights,
         per-region jitted programs out of the PERSISTENT executable
@@ -1710,11 +1946,20 @@ class PTGTaskpool(Taskpool):
             "_region_prog_cache", ExecCache(128))
         runners: Dict[int, Any] = {}
         dev_regions: Dict[int, Dict[str, Any]] = {}
+        cold_regions: set = set()
         for ri, rp in enumerate(plan["regions"]):
-            jitted, _hit = cache.get_or_build(
+            # the cached object is the TIMED wrapper: its first call (the
+            # jit trace+compile) feeds the __region_trace__ pseudo-class
+            # fusion sizing reads back; a cache HIT reuses the wrapper
+            # with the first call already burned, so warm replays never
+            # observe a phantom trace
+            jitted, hit = cache.get_or_build(
                 None if ckey is None else (ckey, ri),
-                lambda rp=rp: jax.jit(
-                    _mk_region_program(rp, fns, written_by_class)))
+                lambda rp=rp: _timed_region_program(
+                    jax.jit(_mk_region_program(rp, fns, written_by_class)),
+                    len(rp["members"])))
+            if not hit:
+                cold_regions.add(ri)
             wb_datas = []
             for dcn, idx in rp["wb_keys"]:
                 dc = self.collections.get(dcn)
@@ -1728,13 +1973,17 @@ class PTGTaskpool(Taskpool):
                     "ext": rp["ext"], "ext_mems": rp["ext_mems"],
                     "out_slots": rp["out_slots"], "jitted": jitted,
                     "wb_pairs": list(enumerate(wb_datas)),
-                    "ntasks": len(rp["members"])}
+                    "ntasks": len(rp["members"]),
+                    "cls": data["cls_of"][rp["members"][0]],
+                    "cold": not hit}
             else:
                 runners[cid] = self._mk_region_runner(
                     graph, cid, rp, jitted, slots, mem_datas, wb_datas,
                     mod)
         lane = {"graph": graph, "slots": slots, "n": flat["n"],
                 "finalized": False}
+        self._ptexec_cost_bind(lane, graph, flat, names, bucket, plan=plan,
+                               cold_regions=cold_regions)
         lane["callback"] = self._mk_ptexec_data_callback(
             flat, classes, slots, mem_datas, writebacks,
             fusion={"orig_of": plan["orig_of"], "regions": runners},
@@ -1745,31 +1994,38 @@ class PTGTaskpool(Taskpool):
         if devlane is not None and plan["dev_mask"] is not None:
             self._ptexec_bind_dev_fused(lane, devlane, flat, plan,
                                         classes, slots, mem_datas,
-                                        writebacks, dev_regions, mod)
+                                        writebacks, dev_regions, mod,
+                                        place_dev, bucket)
         return lane
 
     def _ptexec_bind_dev_fused(self, lane: Dict[str, Any], devlane, flat,
                                plan, classes: List[TaskClass],
                                slots: List[Any], mem_datas,
                                writebacks: Dict[int, List],
-                               dev_regions: Dict[int, Dict], mod) -> None:
+                               dev_regions: Dict[int, Dict], mod,
+                               place_dev: List[bool],
+                               bucket: int = 0) -> None:
         """Device binding for a fused pool: same contract as
         :meth:`_ptexec_bind_dev`, but the mask covers compact nodes and
         device REGIONS dispatch as one region-sized async program on
         the lane (ptdev needs nothing new beyond that region-sized
         dispatch — the retire capsule walks the fused node exactly like
-        any device task, weighted back to original tasks)."""
+        any device task, weighted back to original tasks). ``place_dev``
+        is the cost model's EFFECTIVE placement (ISSUE 18), not the
+        static has-a-device-body shape — the fusion plan's dev_mask was
+        built from the same list, and the two must agree."""
         data = flat["data"]
-        dev_of_class = [self._ptexec_class_device(tc)
-                        and data["ndflows"][ci] > 0
-                        for ci, tc in enumerate(classes)]
+        dev_of_class = [place_dev[ci] and data["ndflows"][ci] > 0
+                        for ci in range(len(classes))]
         graph = lane["graph"]
+        cost_obs = self._ptexec_cost_obs(lane)
         dispatch, poll = self._mk_ptexec_dev_dispatch(
             flat, classes, dev_of_class, slots, mem_datas, writebacks,
             devlane, fusion={"orig_of": plan["orig_of"],
                              "dev_regions": dev_regions, "graph": graph,
                              "evr": mod.EV_REGION, "fls": mod.FLAG_START,
-                             "fle": mod.FLAG_END})
+                             "fle": mod.FLAG_END},
+            cost_obs=cost_obs, bucket=bucket)
         pid = devlane.bind_pool(graph, dispatch, poll)
         lane["dev"] = devlane
         lane["dev_pool"] = pid
@@ -1781,10 +2037,22 @@ class PTGTaskpool(Taskpool):
         graph.dev_bind(devlane.submit_capsule(), pid, plan["dev_mask"])
         devlane.clane.notify()
 
+    def _ptexec_cost_obs(self, lane: Dict[str, Any]):
+        """The device lane's observation dict (ISSUE 18): (class name,
+        bucket, dev) -> [count, sum_ns], written only by the lane's
+        manager thread (dispatch/poll run there — no lock needed) and
+        folded into the cost model at the lane's detach."""
+        from ...core import costmodel as _cm
+        if not _cm.enabled():
+            return None
+        obs = lane.setdefault("cost_dev", {})
+        return obs
+
     def _ptexec_bind_dev(self, lane: Dict[str, Any], devlane, flat,
                          classes: List[TaskClass], dev_classes: List[bool],
                          slots: List[Any], mem_datas,
-                         writebacks: Dict[int, List]) -> None:
+                         writebacks: Dict[int, List],
+                         bucket: int = 0) -> None:
         """Bind a flattened data graph to the native device lane (ISSUE
         10): build the per-pool dispatch/poll closures, register them
         with the lane (the retire capsule routes completions back into
@@ -1807,7 +2075,7 @@ class PTGTaskpool(Taskpool):
         graph = lane["graph"]
         dispatch, poll = self._mk_ptexec_dev_dispatch(
             flat, classes, dev_of_class, slots, mem_datas, writebacks,
-            devlane)
+            devlane, cost_obs=self._ptexec_cost_obs(lane), bucket=bucket)
         pid = devlane.bind_pool(graph, dispatch, poll)
         lane["dev"] = devlane
         lane["dev_pool"] = pid
@@ -1822,7 +2090,8 @@ class PTGTaskpool(Taskpool):
     def _mk_ptexec_dev_dispatch(self, flat, classes: List[TaskClass],
                                 dev_of_class: List[bool], slots: List[Any],
                                 mem_datas, writebacks: Dict[int, List],
-                                devlane, fusion=None):
+                                devlane, fusion=None, cost_obs=None,
+                                bucket=0):
         """The device lane's per-pool dispatch/poll pair, both run on the
         lane's manager thread with the GIL held:
 
@@ -1862,6 +2131,40 @@ class PTGTaskpool(Taskpool):
                 if tc.flows[fi].access & FLOW_ACCESS_WRITE))
         import collections as _collections
         inflight: "_collections.deque" = _collections.deque()
+        # device-side cost observation (ISSUE 18): each inflight entry is
+        # stamped at dispatch and observed at retire — the elapsed window
+        # covers the async compute, the output-ready wait, AND the lane's
+        # poll cadence, i.e. the throughput a task actually experiences
+        # on this path (what placement must compare against the CPU
+        # lane's batch-amortized cost). Stage-ins time separately into
+        # the __stage_in__ pseudo-class. All writes happen on the
+        # manager thread; the fold reads after unbind.
+        _pc = time.perf_counter_ns
+        dev_clock = [0]      # batch-amortization mark (see poll)
+        if cost_obs is not None:
+            from ...core.costmodel import STAGE_IN as _STG, shape_bucket
+            cnames = [f"{self.program.spec.name}.{tc._ptg_spec.name}"
+                      for tc in classes]
+
+            def _obs(key, w, ns):
+                e = cost_obs.get(key)
+                if e is None:
+                    cost_obs[key] = [w, ns]
+                else:
+                    e[0] += w
+                    e[1] += ns
+
+            def _stage(mi):
+                t0 = _pc()
+                copy = dev.lane_stage_in(mem_datas[mi], pin=True)
+                nb = getattr(getattr(copy, "payload", None), "nbytes", 0)
+                _obs((_STG, shape_bucket(nb), "tpu"), 1, _pc() - t0)
+                return copy
+        else:
+            _obs = None
+
+            def _stage(mi):
+                return dev.lane_stage_in(mem_datas[mi], pin=True)
         if fusion is not None:
             # fused pool (ISSUE 12): a device REGION dispatches as one
             # region-sized async program; its inflight/retire id is the
@@ -1884,14 +2187,17 @@ class PTGTaskpool(Taskpool):
             # phase has taken its per-task inflight pins.
             staged: Dict[int, Any] = {}
             batch_pins: List[Any] = []
+            if _obs is not None and not inflight:
+                # idle -> active: restart the amortization clock so idle
+                # gaps between batches never land in any task's cost
+                dev_clock[0] = _pc()
             for i in ids:
                 if _dregs is not None:
                     r = _dregs.get(i)
                     if r is not None:
                         for mi in r["ext_mems"]:
                             if mi not in staged:
-                                copy = dev.lane_stage_in(mem_datas[mi],
-                                                         pin=True)
+                                copy = _stage(mi)
                                 batch_pins.append(copy)
                                 staged[mi] = copy
                         continue
@@ -1904,7 +2210,7 @@ class PTGTaskpool(Taskpool):
                         # pin=True: the eviction pin is taken inside the
                         # table's reserve critical section, so no peer
                         # thread's stage-in can evict this entry first
-                        copy = dev.lane_stage_in(mem_datas[mi], pin=True)
+                        copy = _stage(mi)
                         batch_pins.append(copy)
                         staged[mi] = copy
             # EXEC phase: dispatch each ready device task asynchronously
@@ -1933,8 +2239,11 @@ class PTGTaskpool(Taskpool):
                             slots[s] = v
                         events = tuple(v for v in tuple(outs) + tuple(wbs_v)
                                        if hasattr(v, "is_ready"))
-                        inflight.append((i, events, r["wb_pairs"],
-                                         list(wbs_v), pins, r["ntasks"]))
+                        inflight.append((
+                            i, events, r["wb_pairs"], list(wbs_v), pins,
+                            r["ntasks"],
+                            None if (_obs is None or r.get("cold")) else
+                            (cnames[r["cls"]], bucket, "tpu_fused")))
                         continue
                     oi = _forig[i]
                 k = cls_of[oi]
@@ -1964,16 +2273,19 @@ class PTGTaskpool(Taskpool):
                 for dj in range(nd):
                     slots[base + dj] = vals[dj]
                 inflight.append((i, events, writebacks.get(oi), vals, pins,
-                                 1))
+                                 1,
+                                 None if _obs is None else
+                                 (cnames[k], bucket, "tpu")))
             for copy in batch_pins:         # per-task pins hold from here
                 dev.unpin_copy(copy)
             return len(ids)
 
         def poll():
             done: List[int] = []
+            retired: List[Tuple] = []
             for _ in range(len(inflight)):
                 ent = inflight.popleft()
-                i, events, wbs, vals, pins, w = ent
+                i, events, wbs, vals, pins, w, ckey2 = ent
                 if events and not all(a.is_ready() for a in events):
                     inflight.append(ent)
                     continue
@@ -1989,7 +2301,27 @@ class PTGTaskpool(Taskpool):
                 for copy in pins:
                     dev.unpin_copy(copy)
                 dev.executed_tasks += w
+                retired.append((ckey2, w))
                 done.append(i)
+            if retired and _obs is not None:
+                # batch amortization, the SAME semantics as the C lane's
+                # exec bump: the wall window since the last retire sweep
+                # (or the idle->active mark) divides across every task
+                # weight retired in it. Per-entry dispatch->retire spans
+                # overlap under pipelining, so summing them would bill
+                # the same wall clock N-inflight times over and make the
+                # device look slower than the wall it actually consumed
+                # — placement would then mis-compare against the CPU
+                # lane's throughput-denominated cost. Keyless entries
+                # (cold regions) still weigh in the denominator: they
+                # consumed part of the window.
+                now = _pc()
+                total_w = sum(w for _, w in retired)
+                per = (now - dev_clock[0]) / max(total_w, 1)
+                for ckey2, w in retired:
+                    if ckey2 is not None:
+                        _obs(ckey2, w, per * w)
+                dev_clock[0] = now
             return done
 
         return dispatch, poll

@@ -19,7 +19,14 @@ path:
   one ``TAG_PTFAB {"k": "weights"}`` AM to every rank, where the fabric
   applies them through the new ``Plane.set_weight`` capsule entry —
   weights bind at the next DRR round top-up, so convergence is smooth,
-  not steppy.
+  not steppy;
+* consumer (c) of the online cost model loop (ISSUE 18): the nudge
+  exponent itself ADAPTS to the measured convergence error instead of
+  staying the fixed 0.6 — an error that grew since the last round means
+  the loop overshot (damp the gain), an error that stays large and
+  barely shrinks means it converges too slowly (raise it). Gated by
+  ``--mca costmodel_reconcile`` and clamped to [0.1, 1.5]; every nudge
+  counts ``costmodel.gain_adapted``.
 
 Convergence caveats (documented in docs/serving.md): shares only bind
 while every tenant keeps every rank's drain backlogged (DRR serves an
@@ -72,6 +79,7 @@ class ShareReconciler:
         self._thread: Optional[threading.Thread] = None
         self.rounds = 0
         self.last_err_pct: Optional[float] = None
+        self._prev_err: Optional[float] = None   # gain scheduling state
 
     # ------------------------------------------------------------ scraping
     def _scrape(self) -> Optional[Dict[str, int]]:
@@ -129,10 +137,39 @@ class ShareReconciler:
             new_w[t] = max(1, int(round(w * self._mult[t] * self.scale)))
         self.rounds += 1
         self.last_err_pct = round(err_max, 1)
+        self._adapt_gain(err_max)
         FAB_STATS["reconcile_rounds"] += 1
         FAB_STATS["share_err_pct"] = self.last_err_pct
         self._broadcast(new_w, self.last_err_pct)
         return err_max
+
+    def _adapt_gain(self, err: float) -> None:
+        """Consumer (c) of the online cost model loop (ISSUE 18): the
+        nudge exponent tracks MEASURED convergence error round to round.
+        Error grew >5% over the last round → the loop overshot (the
+        clamped multiplier oscillates around the target): damp the gain
+        by 0.7. Error still large (>5%) and shrinking by less than 30% →
+        too timid: raise it by 1.15. Clamped to [0.1, 1.5] — above ~1
+        the pure-ratio controller is already at the edge of ringing, 0.1
+        still converges, just slowly. One float compare per 4 Hz round:
+        nowhere near any hot path."""
+        from ..utils import mca
+        if not mca.get("costmodel_reconcile", True):
+            self._prev_err = err
+            return
+        prev, self._prev_err = self._prev_err, err
+        if prev is None:
+            return
+        g = self.gain
+        if err > prev * 1.05:
+            g *= 0.7
+        elif err > 5.0 and err > prev * 0.7:
+            g *= 1.15
+        g = min(1.5, max(0.1, g))
+        if g != self.gain:
+            self.gain = g
+            from ..core.costmodel import COSTMODEL_STATS
+            COSTMODEL_STATS["gain_adapted"] += 1
 
     def _broadcast(self, weights: Dict[str, int], err_pct: float) -> None:
         fab = self.fabric

@@ -102,6 +102,7 @@ def install_native_counters() -> None:
     ``trace.*``) so :mod:`parsec_tpu.tools.live_view` and the SDE-style
     snapshot export see the lanes. Idempotent."""
     from ..comm import native as _cnative        # lazy: avoid import cycles
+    from ..core import costmodel as _cm
     from ..core import sched_plane as _sp
     from ..device import native as _dnative
     from ..dsl import dtd as _dtd
@@ -123,7 +124,12 @@ def install_native_counters() -> None:
                           # the persistent executable cache (ISSUE 12):
                           # capture.cache_{hits,misses,evictions} — the
                           # warm-pool contract on /metrics
-                          (_fus.CAPTURE_CACHE_STATS, "capture")):
+                          (_fus.CAPTURE_CACHE_STATS, "capture"),
+                          # the online cost models (ISSUE 18):
+                          # costmodel.{keys,folds,decisions,decision_ns,
+                          # placements_diverged,...} — the adaptive-
+                          # engagement truth the ci gate asserts
+                          (_cm.COSTMODEL_STATS, "costmodel")):
         for key in stats:
             counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
     # the comm lane's C-side wire counters (summed across live lanes)

@@ -23,6 +23,19 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _fresh_cost_model():
+    """The online cost model (ISSUE 18) is process-global by design —
+    it must survive context fini to feed warm instantiations. Under
+    pytest that globality would leak measurements between unrelated
+    tests (a class measured slow in one test steers placement/fusion in
+    the next), so every test starts from a cold model, mirroring how
+    LaneStats snapshots isolate the engagement counters."""
+    yield
+    from parsec_tpu.core import costmodel
+    costmodel.model.reset()
+
+
 @pytest.fixture()
 def context():
     """A fresh single-rank runtime context per test."""
