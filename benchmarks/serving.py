@@ -525,12 +525,280 @@ def fab_gate() -> int:
     return 0 if ok else 1
 
 
+def run_pttel_2rank(stall: bool = True) -> dict:
+    """The mesh-telemetry leg (ISSUE 20): the pttel acceptance program
+    on 2 REAL OS ranks — push rounds on the wire, rollup-vs-truth
+    comparison, push-mode reconciler, and (with ``stall``) a forced
+    stall under the armed watchdog. Returns the merged dict the gate
+    and the bench keys read."""
+    import functools
+    import tempfile
+
+    from parsec_tpu.comm.tcp import run_distributed_procs
+    from parsec_tpu.serving.harness import pttel_2rank_program
+
+    with tempfile.TemporaryDirectory(prefix="pttel-flight-") as fdir:
+        res = run_distributed_procs(
+            2, functools.partial(pttel_2rank_program, stall=stall,
+                                 flight_dir=fdir), timeout=300)
+        if not all(r.get("telemetry") for r in res):
+            return {"telemetry": False,
+                    "reason": next(r.get("reason") for r in res
+                                   if not r.get("telemetry"))}
+        r0, r1 = res
+        rollup_ok = all(
+            r0.get("per_rank_served", {}).get(rank) == r["served_local"]
+            for rank, r in enumerate(res))
+        return {
+            "telemetry": True,
+            "rounds": [r["tel_stats"]["rounds"] for r in res],
+            "frames_tx": [r["tel_stats"]["frames_tx"] for r in res],
+            "frames_rx": [r["tel_stats"]["frames_rx"] for r in res],
+            "tx_errors": sum(r["tel_stats"]["tx_errors"] for r in res),
+            "frame_errors": sum(r["frame_errors"] for r in res),
+            "rollup_matches_truth": rollup_ok,
+            "ranks_seen": r0.get("ranks_seen"),
+            "staleness_s": r0.get("staleness_s"),
+            "reconcile_mode": r0.get("reconcile_mode"),
+            "reconcile": r0.get("reconcile", {}),
+            "watchdog_clean_rank0":
+                r0["watchdog_stats"]["pool_stalls"] == 0 and
+                r0["watchdog_stats"]["device_stalls"] == 0,
+            "stall": r1.get("stall"),
+        }
+
+
+def run_telemetry_overhead(seconds: float = 2.0,
+                           interval_ms: int = 100) -> dict:
+    """``telemetry_overhead_pct`` (ISSUE 20): the serial-chain bench runs
+    with a live telemetry plane (snapshot + fold every ``interval_ms``,
+    the documented production cadence) and an armed watchdog, and the CPU
+    time spent inside ``plane.round()`` (``thread_time``: the cycles
+    telemetry actually steals from the workers, not GIL hand-off waits)
+    is measured against the chain's wall-clock — the per-rank duty cycle
+    the <1% contract bounds. Direct attribution, not an A/B of two noisy
+    wall-clocks: a loaded CI host cannot flake it. The first rounds
+    (registry install, first-snapshot dict growth) are warmup and
+    excluded."""
+    from parsec_tpu.comm.pttel import TelemetryPlane
+    from parsec_tpu.comm.threads import ThreadFabric, ThreadsCE
+    from parsec_tpu.core.context import Context
+    from parsec_tpu.dsl.dtd import DTDTaskpool, RW
+    from parsec_tpu.serving.harness import _force_cpu
+    from parsec_tpu.utils import mca
+    from types import SimpleNamespace
+
+    _force_cpu()
+    mca.set("tel_interval_ms", interval_ms)
+    mca.set("watchdog_stall_ms", 250)
+    plane = None
+    try:
+        ce = ThreadsCE(ThreadFabric(1), 0)
+        plane = TelemetryPlane(SimpleNamespace(ce=ce))
+        tel_s = [0.0]
+        rounds_orig = plane.round
+
+        def timed_round():
+            t0 = time.thread_time()
+            rounds_orig()
+            tel_s[0] += time.thread_time() - t0
+
+        plane.round = timed_round
+        plane.start()
+        ctx = Context(nb_cores=2)        # watchdog arms off the mca knob
+        tp = DTDTaskpool(ctx, "tel-chain")
+        tile = tp.tile_new((2, 2))
+
+        def link(x):
+            return x
+
+        for _ in range(4):               # warmup: registry install,
+            rounds_orig()                # first-snapshot dict growth
+        rounds0, tel_s[0] = [0], 0.0
+        rounds_inner = timed_round
+
+        def counted_round():
+            rounds_inner()
+            rounds0[0] += 1
+
+        plane.round = counted_round
+        chains = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            for _ in range(400):
+                tp.insert_task(link, (tile, RW), jit=False, name="LINK")
+            tp.wait()
+            chains += 1
+        wall = time.perf_counter() - t0
+        tp.close()
+        ctx.wait()
+        ctx.fini()
+        plane.stop(flush=False)
+        return {
+            "telemetry_overhead_pct": round(tel_s[0] / wall * 100.0, 4),
+            "telemetry_round_us": round(
+                tel_s[0] / max(1, rounds0[0]) * 1e6, 1),
+            "telemetry_rounds": rounds0[0],
+            "chain_wall_ms": round(wall * 1e3, 1),
+            "chain_tasks": chains * 400,
+        }
+    finally:
+        if plane is not None:
+            plane.stop(flush=False)
+        mca.set("tel_interval_ms", 0)
+        mca.set("watchdog_stall_ms", 0)
+
+
+class _SimFab:
+    """Stub fabric for the in-process convergence bench: applied weights
+    land here and feed back into the next round's simulated service."""
+
+    def __init__(self, weights):
+        self.nb_ranks = 2
+        self.my_rank = 0
+        self.rde = None
+        self._dead = set()
+        self.applied = {t: 1.0 for t in weights}
+
+    def set_weight(self, tenant, w):
+        self.applied[tenant] = float(w)
+
+
+class _SimMesh:
+    """Two simulated ranks whose per-tenant served rate tracks the
+    currently applied DRR weights — the idealized backlogged-drain
+    response the reconciler's ratio controller assumes."""
+
+    PER_ROUND = 400          # served tasks per rank per round
+
+    def __init__(self, fab):
+        self.fab = fab
+        self.served = {0: {}, 1: {}}
+
+    def advance(self):
+        tot = sum(self.fab.applied.values()) or 1.0
+        for r in self.served:
+            for t, w in self.fab.applied.items():
+                self.served[r][t] = self.served[r].get(t, 0) + \
+                    int(self.PER_ROUND * w / tot)
+
+    def counters(self, rank):
+        return {f"ptfab.served.{t}": v
+                for t, v in self.served[rank].items()}
+
+
+def run_reconcile_convergence(max_rounds: int = 40) -> dict:
+    """``reconcile_convergence_rounds_{push,scrape}`` (ISSUE 20): the
+    same skewed mesh (target 3:1, serving 1:1) reconciled through BOTH
+    input paths — the pushed pttel rollup and the per-rank HTTP-scrape
+    shape — counting rounds until the share error first lands <= 15%.
+    Push must converge exactly like scrape (same controller, same
+    readings); what it removes is the N fetches per round, not rounds."""
+    from parsec_tpu.serving.reconcile import ShareReconciler
+
+    weights = {"tv": 3.0, "ta": 1.0}
+    out = {}
+    for mode in ("push", "scrape"):
+        fab = _SimFab(weights)
+        mesh = _SimMesh(fab)
+        if mode == "push":
+            class _Tel:
+                interval_s = 0.05
+
+                def rollup(self):
+                    return {"ranks": {
+                        r: {"staleness_s": 0.0, "counters": mesh.counters(r)}
+                        for r in (0, 1)}}
+
+            rec = ShareReconciler(fab, [], weights, period=0.01, tel=_Tel())
+        else:
+            rec = ShareReconciler(fab, [], weights, period=0.01, tel=None)
+            rec._from_http = lambda: (  # the scrape SHAPE, no sockets
+                {r: rec._served_of(mesh.counters(r)) for r in (0, 1)}, set())
+        for _ in range(max_rounds):
+            mesh.advance()
+            rec.step()
+            if rec.converged_round is not None:
+                break
+        out[f"reconcile_convergence_rounds_{mode}"] = rec.converged_round
+        out[f"reconcile_final_err_pct_{mode}"] = rec.last_err_pct
+    return out
+
+
+def tel_gate() -> int:
+    """ci.sh pttel engagement gate (2 OS ranks): nonzero TAG_PTTEL push
+    rounds with zero frame errors, the pushed rollup equal to the
+    per-rank registry truth, the push-mode reconciler issuing ZERO HTTP
+    fetches, a clean watchdog on the un-injected rank, and the forced
+    stall producing exactly one attributed flight record."""
+    r = run_pttel_2rank(stall=True)
+    print("pttel gate:", {k: r.get(k) for k in
+                          ("rounds", "frames_tx", "frames_rx",
+                           "reconcile_mode", "staleness_s")})
+    if not r.get("telemetry"):
+        # the plane needs the native comm lane + scheduler plane; when
+        # the environment can't build them this gate cannot run — report
+        # loudly but don't fail CI on an attributed env limit
+        print(f"SKIP pttel gate: {r.get('reason')}")
+        return 0
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        print(("PASS " if cond else "FAIL ") + msg)
+        ok = ok and cond
+
+    check(all(n > 0 for n in r["rounds"]),
+          f"telemetry rounds ran on every rank ({r['rounds']})")
+    check(r["frames_tx"][1] > 0 and r["frames_rx"][0] > 0,
+          f"TAG_PTTEL frames on the wire (tx {r['frames_tx']}, "
+          f"rx {r['frames_rx']})")
+    check(r["frames_tx"][0] == 0, "the root sends no frames upward")
+    check(r["frame_errors"] == 0 and r["tx_errors"] == 0,
+          "zero frame/tx errors")
+    check(r["rollup_matches_truth"],
+          "pushed rollup equals per-rank registry truth")
+    rec = r["reconcile"]
+    check(r["reconcile_mode"] == "push" and rec.get("push_rounds", 0) > 0,
+          f"reconciler ran in push mode ({rec.get('push_rounds')} rounds)")
+    check(rec.get("http_fetches", 0) == 0,
+          "reconciler issued zero HTTP fetches in push mode")
+    check(r["watchdog_clean_rank0"],
+          "watchdog clean on the un-injected rank (no false positives)")
+    st = r.get("stall") or {}
+    check(st.get("watchdog", {}).get("pool_stalls") == 1,
+          f"forced stall detected "
+          f"({st.get('detected_ms')}ms, threshold 500ms)")
+    check(st.get("detected_ms", 1e9) <= 2 * 500,
+          "detection within 2x watchdog_stall_ms")
+    check(st.get("flight_records") == 1,
+          f"exactly one flight record ({st.get('flight_records')})")
+
+    ov = run_telemetry_overhead()
+    print("pttel overhead:", ov)
+    check(ov["telemetry_overhead_pct"] < 1.0,
+          f"telemetry duty cycle {ov['telemetry_overhead_pct']}% "
+          f"under the <1% contract")
+    cv = run_reconcile_convergence()
+    print("pttel convergence:", cv)
+    check(cv["reconcile_convergence_rounds_push"] is not None,
+          f"push-mode reconciler converged "
+          f"(round {cv['reconcile_convergence_rounds_push']})")
+    check(cv["reconcile_convergence_rounds_scrape"] is not None,
+          f"scrape-mode reconciler converged "
+          f"(round {cv['reconcile_convergence_rounds_scrape']})")
+    return 0 if ok else 1
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ci-gate", action="store_true",
                     help="multi-pool plane engagement smoke (ci.sh)")
     ap.add_argument("--fab-gate", action="store_true",
                     help="cross-rank serving fabric engagement gate "
+                         "(2 OS ranks, ci.sh)")
+    ap.add_argument("--tel-gate", action="store_true",
+                    help="mesh telemetry engagement gate "
                          "(2 OS ranks, ci.sh)")
     ap.add_argument("--pools", type=int, default=8)
     ap.add_argument("--threads", type=int, default=None)
@@ -544,6 +812,8 @@ def main() -> None:
         sys.exit(ci_gate())
     if args.fab_gate:
         sys.exit(fab_gate())
+    if args.tel_gate:
+        sys.exit(tel_gate())
     weights = [int(w) for w in args.weights.split(",")] \
         if args.weights else None
     r = run_serving(npools=args.pools, nthreads=args.threads,

@@ -163,6 +163,16 @@ echo "== cross-rank serving fabric engagement smoke (ptfab, 2 ranks) =="
 # tolerance of the global weights. Engagement counters, not timing.
 JAX_PLATFORMS=cpu timeout 420 python3 benchmarks/serving.py --fab-gate
 
+echo "== mesh telemetry engagement smoke (pttel, 2 ranks) =="
+# ISSUE 20: nonzero TAG_PTTEL push rounds with zero frame errors, the
+# pushed rollup EQUAL to the per-rank registry truth after quiesce, the
+# reconciler running in push mode with ZERO per-round HTTP fetches, a
+# clean watchdog on the healthy rank, and a forced stall detected within
+# 2x watchdog_stall_ms producing exactly one attributed flight record;
+# plus the telemetry duty cycle under the <1% overhead contract and the
+# push/scrape reconciler convergence-round keys.
+JAX_PLATFORMS=cpu timeout 420 python3 benchmarks/serving.py --tel-gate
+
 echo "== native comm lane engagement smoke (2 ranks) =="
 # same contract as the execution-lane gates: assert ENGAGEMENT, not
 # throughput — a 2-OS-rank chain whose every edge crosses ranks must ride

@@ -254,6 +254,15 @@ class Context:
         #: percentiles); off = one null branch per lane event site
         self._hist_on = bool(mca.get("hist_enabled", False)) or \
             self.metrics is not None
+        #: lane stall watchdog (core/watchdog.py): armed by --mca
+        #: watchdog_stall_ms; reads existing counters only (the PR 13
+        #: no-new-hot-path contract), degrades /health on a latched
+        #: stall and triggers the flight recorder
+        self.watchdog = None
+        wd_ms = mca.get("watchdog_stall_ms", 0)
+        if wd_ms > 0:
+            from .watchdog import StallWatchdog
+            self.watchdog = StallWatchdog(self, stall_ms=wd_ms).start()
         if self.sched_plane is not None:
             # sched.queue_ns (push->pop wait) joins the lane histograms
             self._hist_attach("sched", self.sched_plane.plane)
@@ -518,6 +527,11 @@ class Context:
         # it back at its first placement decision and starts warm
         from .costmodel import model as _cost_model
         _cost_model.maybe_save()
+        if self.watchdog is not None:
+            # watchdog before the endpoint: a dying context must not be
+            # reported as a stall, and /health must answer to the end
+            self.watchdog.stop()
+            self.watchdog = None
         if self.metrics is not None:
             # endpoint down LAST: ops dashboards may scrape through the
             # drain, and the fini counter aggregation itself is scrapeable

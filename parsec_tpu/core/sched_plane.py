@@ -29,6 +29,7 @@ See docs/scheduling.md for the policy matrix and the weight math.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 import zlib
 from typing import Dict, Optional
@@ -84,14 +85,16 @@ _live_lock = threading.Lock()
 
 
 def plane_counter_sampler(key: str):
-    """A registry sampler summing one plane stat over live planes."""
+    """A registry sampler summing one plane stat over live planes (the
+    short-TTL snapshot means one registry sweep costs one stats() call
+    per plane, not one per counter key — the comm/device lane idiom)."""
     def sample():
         total = 0
         with _live_lock:
             planes = list(_live_planes)
         for sp in planes:
             try:
-                total += sp.stats().get(key, 0)
+                total += sp.stats_cached().get(key, 0)
             except Exception:  # noqa: BLE001 — a torn-down plane
                 pass
         return total
@@ -115,6 +118,7 @@ class SchedPlane:
         self.KIND_EXT = mod.KIND_EXT
         self._pools: Dict[int, str] = {}       # handle -> pool name
         self._lock = threading.Lock()
+        self._stats_cache: tuple = (0.0, None)  # (stamp, snapshot)
         with _live_lock:
             _live_planes.add(self)
 
@@ -239,6 +243,16 @@ class SchedPlane:
     # ------------------------------------------------------------- stats
     def stats(self) -> Dict[str, int]:
         return self.plane.stats()
+
+    def stats_cached(self, ttl: float = 0.02) -> Dict[str, int]:
+        """:meth:`stats` behind a short TTL — one registry sweep (6
+        ``sched.*`` sampler keys) pays one native stats() call, not 6."""
+        now = time.monotonic()
+        stamp, snap = self._stats_cache
+        if snap is None or now - stamp > ttl:
+            snap = self.plane.stats()
+            self._stats_cache = (now, snap)
+        return snap
 
     def pool_stats(self, h: int) -> Dict[str, int]:
         return self.plane.pool_stats(h)

@@ -360,6 +360,207 @@ def fabric_2rank_program(rank, ce, *, isolation_s: float = 2.0,
     return out
 
 
+def pttel_2rank_program(rank, ce, *, load_s: float = 1.2,
+                        tel_interval_ms: int = 25,
+                        watchdog_stall_ms: int = 500,
+                        stall: bool = False,
+                        flight_dir: str = "") -> Dict:
+    """The ISSUE 20 acceptance program (2 OS ranks): the pttel push
+    plane under real serving load.
+
+    Both ranks serve two tenants and feed them through the gateway for
+    ``load_s``; the telemetry plane pushes counter deltas up the tree
+    the whole time. After quiescing, rank 0 waits for the pushed rollup
+    to settle and reports BOTH views of every ``ptfab.served.*``
+    counter — the rolled-up value and each rank's own registry value
+    travels back in the per-rank results — so the driver can assert the
+    tree-aggregated numbers equal the per-rank truth exactly. Rank 0
+    also runs push-mode reconciler rounds and reports the
+    ``reconcile.*`` deltas (the zero-HTTP-fetch contract).
+
+    With ``stall=True`` rank 1 injects a never-drained KIND_EXT plane
+    pool under its (already armed) watchdog and waits for detection:
+    exactly one attributed flight record must land in ``flight_dir``.
+    Rank 0's watchdog runs the whole time WITHOUT an injected stall —
+    its clean ``watchdog.*`` counters are the zero-false-positive
+    evidence under real load."""
+    import glob
+    import threading
+
+    _force_cpu()
+    from ..comm.remote_dep import RemoteDepEngine
+    from ..comm.pttel import TEL_STATS
+    from ..core.context import Context
+    from ..core.watchdog import WATCHDOG_STATS
+    from ..serving.fabric import ServingFabric
+    from ..serving.gateway import IngestGateway
+    from ..serving.reconcile import RECONCILE_STATS, ShareReconciler
+    from ..tools.metrics_server import MetricsServer
+    from ..utils import mca
+    from ..utils.counters import counters
+
+    mca.set("dtd_window_size", 64)
+    mca.set("sched_quantum", 4)
+    mca.set("comm_thread", True)
+    mca.set("tel_interval_ms", tel_interval_ms)
+    mca.set("tel_fanout", 2)
+    mca.set("watchdog_stall_ms", watchdog_stall_ms)
+    if flight_dir:
+        mca.set("flight_dir", flight_dir)
+    nb_ranks = ce.nb_ranks
+    ctx_d = Context(nb_cores=1, my_rank=rank, nb_ranks=nb_ranks)
+    rde = RemoteDepEngine(ctx_d, ce)
+    lane = rde.native
+    tel = rde.telemetry
+    if lane is None or tel is None:
+        ce.sync()
+        ctx_d.fini()
+        ce.fini()
+        return {"telemetry": False,
+                "reason": "native comm lane down" if lane is None
+                else "telemetry plane not built"}
+    ctx_d.start()                       # rde progress + telemetry pusher up
+    ctx_l = Context(nb_cores=2)         # watchdog arms here (mca above)
+    plane = ctx_l.sched_plane
+    if plane is None:
+        ce.sync()
+        ctx_l.fini()
+        ctx_d.fini()
+        ce.fini()
+        return {"telemetry": False, "reason": "scheduler plane down"}
+    fab = ServingFabric(lane.comm, plane, rank, nb_ranks, rde=rde,
+                        lane=lane)
+    tv = _TenantHost(ctx_l, "tv", 256, 1_000_000, weight=2)
+    ta = _TenantHost(ctx_l, "ta", 256, 500_000)
+    fab.serve("tv", handler=tv.ingest, taskpool=tv.tp)
+    fab.serve("ta", handler=ta.ingest, taskpool=ta.tp)
+    ctx_l.start()
+    ms = MetricsServer(rank=rank, nb_ranks=nb_ranks, port=0).start()
+    fab.announce_endpoint(ms.endpoint)
+    gw = IngestGateway(fab)
+    rec_before = RECONCILE_STATS.snapshot()
+    ce.sync()
+
+    out: Dict = {"telemetry": True, "rank": rank}
+
+    # ---- load phase: both tenants, modest rate, every rank ----------
+    t_end = time.monotonic() + load_s
+    n = 0
+    from ..dsl.dtd import AdmissionBackpressure
+    while time.monotonic() < t_end:
+        for t in ("tv", "ta"):
+            try:
+                gw.submit(t, {"n": n}, nowait=True)
+            except AdmissionBackpressure:
+                pass
+            except (RuntimeError, TimeoutError):
+                break
+        n += 1
+        time.sleep(2e-3)
+    ce.sync()
+    for host in (tv, ta):
+        host.tp.wait(timeout=120)
+
+    # ---- push-mode reconciler rounds (rank 0) -----------------------
+    # the serve counters are frozen now (load done), so the interesting
+    # assertions are mechanical: rounds ran off the pushed rollup with
+    # ZERO per-round HTTP fetches
+    if rank == 0:
+        deadline = time.monotonic() + 15
+        while len(fab.endpoints) < nb_ranks and \
+                time.monotonic() < deadline:
+            time.sleep(5e-3)
+        eps = [fab.endpoints[r] for r in sorted(fab.endpoints)]
+        rec = ShareReconciler(fab, eps, {"tv": 2.0, "ta": 1.0},
+                              period=0.05, tel="auto")
+        for _ in range(6):
+            rec.step()
+            time.sleep(max(0.06, 2 * tel_interval_ms / 1e3))
+        out["reconcile"] = RECONCILE_STATS.delta(rec_before)
+        out["reconcile_mode"] = rec.last_mode
+    ce.sync()
+
+    # ---- quiesced rollup-vs-truth comparison ------------------------
+    served_local = {k: v for k, v in counters.snapshot().items()
+                    if k.startswith("ptfab.served.")}
+    out["served_local"] = served_local
+    tel.flush()
+    if rank == 0:
+        # the background pusher keeps folding; wait for the rolled-up
+        # ptfab.served.* columns to settle (all ranks quiesced above)
+        def served_view():
+            roll = tel.rollup()
+            return {r: {k: v for k, v in ent["counters"].items()
+                        if k.startswith("ptfab.served.")}
+                    for r, ent in roll["ranks"].items()}
+        deadline = time.monotonic() + 15
+        prev = None
+        while time.monotonic() < deadline:
+            cur = served_view()
+            if len(cur) == nb_ranks and cur == prev:
+                break
+            prev = cur
+            time.sleep(max(0.1, 3 * tel_interval_ms / 1e3))
+        roll = tel.rollup()
+        out["rollup_served"] = {k: v for k, v in roll["rollup"].items()
+                                if k.startswith("ptfab.served.")}
+        out["per_rank_served"] = served_view()
+        out["staleness_s"] = {r: ent["staleness_s"]
+                              for r, ent in roll["ranks"].items()}
+        out["ranks_seen"] = sorted(roll["ranks"])
+        out["depth"] = roll["depth"]
+    ce.sync()
+
+    # ---- forced stall (rank 1 only, when asked) ---------------------
+    wd = ctx_l.watchdog
+    out["watchdog_armed"] = wd is not None
+    if stall and rank == 1 and wd is not None:
+        before = WATCHDOG_STATS.snapshot()
+        h = plane.register_pool("stall-inject", plane.KIND_EXT,
+                                weight=1, window=0)
+        if h >= 0:
+            plane.admit(h, 4)           # held work that never drains
+        t0 = time.monotonic()
+        deadline = t0 + 4 * watchdog_stall_ms / 1e3
+        while WATCHDOG_STATS["pool_stalls"] <= before["pool_stalls"] \
+                and time.monotonic() < deadline:
+            time.sleep(watchdog_stall_ms / 1e3 / 20)
+        detected_ms = round((time.monotonic() - t0) * 1e3, 1)
+        # the counter ticks BEFORE the watchdog thread finishes writing
+        # the dump: give the file its own (bounded) wait
+        nrec = 0
+        while flight_dir and time.monotonic() < deadline + 2.0:
+            nrec = len(glob.glob(f"{flight_dir}/flight-r*-*.json"))
+            if nrec:
+                break
+            time.sleep(0.02)
+        out["stall"] = {
+            "detected_ms": detected_ms,
+            "watchdog": WATCHDOG_STATS.delta(before),
+            "flight_records": nrec,
+        }
+        if h >= 0:
+            plane.unregister_pool(h)
+    ce.sync()
+
+    # ---- teardown + evidence ----------------------------------------
+    fab.fini()
+    for host in (tv, ta):
+        host.tp.wait(timeout=120)
+        host.tp.close()
+    ctx_l.wait(timeout=120)
+    s = lane.comm.stats()
+    out["frame_errors"] = s["frame_errors"]
+    out["tel_stats"] = TEL_STATS.snapshot()
+    out["watchdog_stats"] = WATCHDOG_STATS.snapshot()
+    ce.sync()
+    ms.stop()
+    ctx_l.fini()
+    ctx_d.fini()
+    ce.fini()
+    return out
+
+
 def reclaim_2rank_program(rank, ce, *, window: int = 32) -> Dict:
     """Peer-death containment, with REAL processes: rank 0 serves a
     windowed tenant, grants credits, then dies mid-window (hard

@@ -7,9 +7,16 @@ This module closes the loop without a global lock anywhere near the hot
 path:
 
 * rank 0 runs a :class:`ShareReconciler` — a slow control loop (default
-  4 Hz) that scrapes every rank's ``/metrics`` endpoint (the PR 8
-  observability plane) for the ``ptfab.served.<tenant>`` counters the
-  fabric registers per served tenant;
+  4 Hz) that reads every rank's ``ptfab.served.<tenant>`` counters. With
+  the pttel telemetry plane running (ISSUE 20) the readings come out of
+  the PUSHED mesh rollup — zero HTTP fetches per round, the tree already
+  delivered every rank's counters to rank 0; without it the loop falls
+  back to scraping each rank's ``/metrics`` endpoint (the PR 8
+  observability plane). Either way a missing rank (stale in the rollup,
+  or a failed fetch) no longer voids the round: the loop reconciles over
+  the reporting ranks (``reconcile.partial_rounds``) and skips only the
+  missing ranks' weight nudges — their cumulative counters make the next
+  delta span both rounds;
 * each round it computes the MEASURED global share of every tenant over
   the last window (served deltas summed across ranks), compares against
   the target share from the global weights, and nudges a per-tenant
@@ -39,10 +46,20 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..utils import output
+from ..utils.counters import LaneStats
 from .fabric import FAB_STATS, ServingFabric
+
+#: exported as ``reconcile.*`` by install_native_counters
+RECONCILE_STATS = LaneStats(
+    push_rounds=0,      # rounds fed by the pttel mesh rollup (0 fetches)
+    scrape_rounds=0,    # rounds that fell back to per-rank HTTP
+    http_fetches=0,     # individual /metrics GETs issued (fallback only)
+    partial_rounds=0,   # rounds reconciled with >= 1 rank missing
+    missing_ranks=0,    # cumulative missing-rank observations
+)
 
 
 class ShareReconciler:
@@ -62,10 +79,15 @@ class ShareReconciler:
     #: runaway boost): skip the nudge, keep the baseline
     MIN_WINDOW_TASKS = 32
 
+    #: a rank whose rollup entry is staler than this many telemetry
+    #: intervals counts as missing for the round (push mode): nudging on
+    #: a frozen snapshot would mis-read a live tenant as starved
+    STALE_INTERVALS = 8.0
+
     def __init__(self, fabric: ServingFabric, endpoints: List[str],
                  weights: Dict[str, float], *, period: float = 0.25,
                  gain: float = 0.6, max_mult: float = 16.0,
-                 scale: Optional[int] = None) -> None:
+                 scale: Optional[int] = None, tel: Any = "auto") -> None:
         self.fabric = fabric
         self.endpoints = list(endpoints)   # rank-indexed /metrics addrs
         self.weights = dict(weights)       # tenant -> global weight
@@ -73,44 +95,119 @@ class ShareReconciler:
         self.gain = gain
         self.max_mult = max_mult
         self.scale = scale if scale is not None else self.SCALE
+        #: "auto" = discover the telemetry plane through fabric.rde per
+        #: round (it attaches after the reconciler in some harnesses);
+        #: None = HTTP only; a TelemetryPlane pins the push source
+        self.tel = tel
         self._mult = {t: 1.0 for t in weights}       # nudged multiplier
-        self._last: Optional[Dict[str, int]] = None  # served at last round
+        #: per-rank served-at-last-round; a missing rank KEEPS its old
+        #: entry so its next delta spans the gap (cumulative counters)
+        self._last: Optional[Dict[int, Dict[str, int]]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.rounds = 0
         self.last_err_pct: Optional[float] = None
+        self.last_mode: Optional[str] = None     # "push" | "scrape"
+        self.converged_round: Optional[int] = None  # first round <= 15%
         self._prev_err: Optional[float] = None   # gain scheduling state
 
     # ------------------------------------------------------------ scraping
-    def _scrape(self) -> Optional[Dict[str, int]]:
-        """Global served-per-tenant: the ptfab.served.* counters summed
-        over every rank's /metrics. ANY failed endpoint voids the whole
-        round (None): a partial sum would read a tenant served mostly on
-        the missing rank as STARVED and runaway-boost its weight — the
-        loop is advisory and must mis-steer on no round."""
+    def _telemetry(self):
+        if self.tel == "auto":
+            return getattr(getattr(self.fabric, "rde", None),
+                           "telemetry", None)
+        return self.tel or None
+
+    def _served_of(self, counters: Dict[str, Any]) -> Dict[str, int]:
+        return {t: int(counters.get(f"ptfab.served.{t}", 0) or 0)
+                for t in self.weights}
+
+    def _from_push(self, tel) -> Optional[
+            Tuple[Dict[int, Dict[str, int]], Set[int]]]:
+        """Read the round out of the pushed mesh rollup: zero network
+        traffic here — the tree already delivered every rank's counters.
+        A rank absent from the rollup (or staler than STALE_INTERVALS
+        telemetry rounds) counts as missing for this round."""
+        roll = tel.rollup()
+        ranks = roll.get("ranks", {})
+        bound = max(0.25, self.STALE_INTERVALS * tel.interval_s)
+        per_rank: Dict[int, Dict[str, int]] = {}
+        missing: Set[int] = set()
+        for r in range(self.fabric.nb_ranks):
+            ent = ranks.get(r)
+            if ent is None or ent.get("staleness_s", bound) > bound:
+                missing.add(r)
+                continue
+            per_rank[r] = self._served_of(ent.get("counters", {}))
+        if not per_rank:
+            return None           # no subtree landed yet: let HTTP try
+        RECONCILE_STATS["push_rounds"] += 1
+        self.last_mode = "push"
+        return per_rank, missing
+
+    def _from_http(self) -> Optional[
+            Tuple[Dict[int, Dict[str, int]], Set[int]]]:
+        """Fallback: per-rank /metrics GETs. A failed endpoint no longer
+        voids the round — it joins the missing set and only its nudges
+        are skipped (the partial-round contract, ISSUE 20 satellite)."""
         from ..tools.metrics_server import fetch
-        served = {t: 0 for t in self.weights}
-        for ep in self.endpoints:
+        per_rank: Dict[int, Dict[str, int]] = {}
+        missing: Set[int] = set()
+        for r, ep in enumerate(self.endpoints):
             try:
-                counters = fetch(ep)["counters"]
-            except Exception:  # noqa: BLE001 — scrape again next round
-                return None
-            for t in served:
-                served[t] += int(counters.get(f"ptfab.served.{t}", 0) or 0)
-        return served
+                RECONCILE_STATS["http_fetches"] += 1
+                per_rank[r] = self._served_of(fetch(ep)["counters"])
+            except Exception:  # noqa: BLE001 — scrape that rank next round
+                missing.add(r)
+        if not per_rank:
+            return None
+        RECONCILE_STATS["scrape_rounds"] += 1
+        self.last_mode = "scrape"
+        return per_rank, missing
+
+    def _scrape(self):
+        """The round's readings: the pushed rollup when the telemetry
+        plane runs, per-rank HTTP otherwise. Returns ``(per_rank,
+        missing)`` — or a flat ``{tenant: total}`` dict from legacy
+        monkeypatched tests, which :meth:`step` normalizes."""
+        tel = self._telemetry()
+        if tel is not None:
+            got = self._from_push(tel)
+            if got is not None:
+                return got
+        return self._from_http()
 
     # ------------------------------------------------------------- rounds
     def step(self) -> Optional[float]:
         """One reconciliation round; returns the max share error (pct)
         over the window, or None when the window carried no service."""
-        served = self._scrape()
-        if served is None:
+        got = self._scrape()
+        if got is None:
             return None           # _last unchanged: cumulative counters
                                   # make the next delta span both rounds
-        last, self._last = self._last, served
-        if last is None:
+        if isinstance(got, dict):
+            # legacy monkeypatched scrape: flat {tenant: mesh total} —
+            # model it as a single pseudo-rank so the math is unchanged
+            per_rank: Dict[int, Dict[str, int]] = {
+                0: {t: int(got.get(t, 0) or 0) for t in self.weights}}
+            missing: Set[int] = set()
+        else:
+            per_rank, missing = got
+        if missing:
+            RECONCILE_STATS["partial_rounds"] += 1
+            RECONCILE_STATS["missing_ranks"] += len(missing)
+        last = self._last or {}
+        # missing ranks keep their old entry: the cumulative counters
+        # make their next delta span the gap instead of losing it
+        self._last = {**last, **per_rank}
+        common = [r for r in per_rank if r in last]
+        if not common:
             return None
-        delta = {t: max(0, served[t] - last.get(t, 0)) for t in served}
+        delta = {t: 0 for t in self.weights}
+        for r in common:
+            cur, prev = per_rank[r], last[r]
+            for t in delta:
+                delta[t] += max(0, cur.get(t, 0) - prev.get(t, 0))
         total = sum(delta.values())
         tot_w = sum(self.weights.values())
         if total < self.MIN_WINDOW_TASKS or tot_w <= 0:
@@ -137,10 +234,12 @@ class ShareReconciler:
             new_w[t] = max(1, int(round(w * self._mult[t] * self.scale)))
         self.rounds += 1
         self.last_err_pct = round(err_max, 1)
+        if self.converged_round is None and err_max <= 15.0:
+            self.converged_round = self.rounds
         self._adapt_gain(err_max)
         FAB_STATS["reconcile_rounds"] += 1
         FAB_STATS["share_err_pct"] = self.last_err_pct
-        self._broadcast(new_w, self.last_err_pct)
+        self._broadcast(new_w, self.last_err_pct, skip=missing)
         return err_max
 
     def _adapt_gain(self, err: float) -> None:
@@ -171,9 +270,13 @@ class ShareReconciler:
             from ..core.costmodel import COSTMODEL_STATS
             COSTMODEL_STATS["gain_adapted"] += 1
 
-    def _broadcast(self, weights: Dict[str, int], err_pct: float) -> None:
+    def _broadcast(self, weights: Dict[str, int], err_pct: float,
+                   skip: Iterable[int] = ()) -> None:
         fab = self.fabric
-        # apply locally first (rank 0 serves too), then AM the peers
+        skip = set(skip)
+        # apply locally first (rank 0 serves too), then AM the peers;
+        # ranks missing from this round's readings are skipped — their
+        # share was not measured, so a nudge would mis-steer them
         for t, w in weights.items():
             fab.set_weight(t, w)
         if fab.rde is None:
@@ -181,7 +284,7 @@ class ShareReconciler:
         from ..comm.engine import TAG_PTFAB
         hdr = {"k": "weights", "w": weights, "err": err_pct}
         for r in range(fab.nb_ranks):
-            if r == fab.my_rank or r in fab._dead:
+            if r == fab.my_rank or r in fab._dead or r in skip:
                 continue
             try:
                 fab.rde.ce.send_am(TAG_PTFAB, r, hdr, None)

@@ -10,9 +10,15 @@ server play: every rank runs a tiny stdlib HTTP server (TCP on
 * ``GET /metrics``     — ``{"rank", "nb_ranks", "pid", "ts",
   "counters": {...unified registry snapshot...},
   "percentiles": {...native latency histogram summaries...}}``
-* ``GET /health``      — liveness probe (``{"ok": true, "rank": r}``)
+* ``GET /health``      — liveness probe (``{"ok": true, "rank": r}``);
+  when a stall watchdog is armed (``--mca watchdog_stall_ms``) a latched
+  stall degrades it to ``ok: false`` with the attributed stall list
 * ``GET /histograms``  — raw log2 bucket arrays (non-zero entries), for
   consumers that want to merge distributions instead of percentiles
+* ``GET /mesh``        — rank 0 only in practice: the telemetry plane's
+  tree-aggregated mesh rollup (``comm/pttel.py``) — summed counters,
+  merged histogram buckets, per-rank gauges and per-rank staleness —
+  with zero per-request cross-rank traffic (the data was pushed here)
 
 Started from ``Context`` init via ``--mca metrics_port <base>`` (rank r
 binds ``base + r``, loopback only) or ``--mca metrics_uds <path>``
@@ -72,11 +78,13 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
         try:
             if path == "/health":
-                body = {"ok": True, "rank": srv.rank, "pid": os.getpid()}
+                body = srv.health_body()
             elif path == "/metrics":
                 body = srv.metrics_body()
             elif path == "/histograms":
                 body = srv.histograms_body()
+            elif path == "/mesh":
+                body = srv.mesh_body()
             else:
                 self.send_error(404, "unknown path (try /metrics)")
                 return
@@ -148,6 +156,34 @@ class MetricsServer:
         self._srv.metrics = self   # type: ignore[attr-defined]
 
     # ------------------------------------------------------------- bodies
+    def health_body(self) -> Dict[str, Any]:
+        body: Dict[str, Any] = {"ok": True, "rank": self.rank,
+                                "pid": os.getpid()}
+        try:
+            from ..core.watchdog import health_report
+            wd = health_report()
+        except Exception:  # noqa: BLE001 — health must still answer
+            wd = None
+        if wd is not None:
+            body["watchdog"] = wd
+            if wd["degraded"]:
+                body["ok"] = False
+        return body
+
+    def mesh_body(self) -> Dict[str, Any]:
+        """The telemetry plane's mesh rollup — only meaningful where the
+        tree's frames land (rank 0), but any rank answers with whatever
+        subtree it has folded, attributed when the plane is off."""
+        from ..comm.pttel import current_plane
+        tel = current_plane()
+        if tel is None:
+            return {"rank": self.rank, "ts": time.time(), "mesh": None,
+                    "reason": "telemetry plane not running "
+                              "(--mca tel_interval_ms 0)"}
+        body = tel.rollup()
+        body["ts"] = time.time()
+        return body
+
     def metrics_body(self) -> Dict[str, Any]:
         from ..utils.hist import histograms
         return {

@@ -70,13 +70,22 @@ class CounterRegistry:
         with self._lock:
             return self._acc.get(name, 0)
 
-    def snapshot(self) -> Dict[str, Union[int, float]]:
-        """All counters at once (the aggregator_visu export)."""
+    def snapshot(self, skip: Optional[Callable[[str], bool]] = None
+                 ) -> Dict[str, Union[int, float]]:
+        """All counters at once (the aggregator_visu export). ``skip``
+        filters keys BEFORE their samplers run — a sweeper that doesn't
+        want a family of derived gauges (pttel skips ``*.hist.*``) must
+        not pay for computing them."""
         out: Dict[str, Union[int, float]] = {}
         with self._lock:
             out.update(self._acc)
             samplers = dict(self._samplers)
+        if skip is not None:
+            for name in [n for n in out if skip(n)]:
+                del out[name]
         for name, s in samplers.items():
+            if skip is not None and skip(name):
+                continue
             try:
                 out[name] = s()
             except Exception:  # noqa: BLE001 - sampling must never break
@@ -102,13 +111,17 @@ def install_native_counters() -> None:
     ``trace.*``) so :mod:`parsec_tpu.tools.live_view` and the SDE-style
     snapshot export see the lanes. Idempotent."""
     from ..comm import native as _cnative        # lazy: avoid import cycles
+    from ..comm import pttel as _tel
     from ..core import costmodel as _cm
     from ..core import sched_plane as _sp
+    from ..core import watchdog as _wd
     from ..device import native as _dnative
     from ..dsl import dtd as _dtd
     from ..dsl import fusion as _fus
     from ..dsl.ptg import compiler as _ptg
     from ..serving import fabric as _fab
+    from ..serving import reconcile as _rec
+    from ..tools import flight as _fl
     from . import native_trace as _nt
     from .hist import install_hist_counters
 
@@ -129,7 +142,16 @@ def install_native_counters() -> None:
                           # costmodel.{keys,folds,decisions,decision_ns,
                           # placements_diverged,...} — the adaptive-
                           # engagement truth the ci gate asserts
-                          (_cm.COSTMODEL_STATS, "costmodel")):
+                          (_cm.COSTMODEL_STATS, "costmodel"),
+                          # the mesh telemetry plane (ISSUE 20):
+                          # pttel.{rounds,frames_tx,frames_rx,folds,...}
+                          # — the O(log P) frame contract on /metrics
+                          (_tel.TEL_STATS, "pttel"),
+                          # the lane stall watchdog + flight recorder +
+                          # push-mode reconciler (ISSUE 20)
+                          (_wd.WATCHDOG_STATS, "watchdog"),
+                          (_fl.FLIGHT_STATS, "flight"),
+                          (_rec.RECONCILE_STATS, "reconcile")):
         for key in stats:
             counters.register(f"{prefix}.{key}", sampler=_sampler(stats, key))
     # the comm lane's C-side wire counters (summed across live lanes)

@@ -1,0 +1,523 @@
+"""pttel mesh telemetry + watchdog + flight recorder (ISSUE 20) tests.
+
+Five layers, mirroring how the observability plane is built:
+
+* **tree/fold units** — the pure math of the reduction tree
+  (parent/children inverses, depth bound) and :func:`fold_entry`
+  (delta telescoping, seq idempotence) plus the sparse histogram
+  bucket merge equalling per-rank sums;
+* **in-process 8-rank mesh** — real :class:`TelemetryPlane` instances
+  over a ThreadsCE fabric, rounds driven deterministically: the
+  O(log P) frame contract (<= 1 tx frame per rank per round, <= fanout
+  rx), full-mesh convergence at the root, and delta correctness for a
+  marker counter that CHANGES between rounds;
+* **watchdog** — a real Context scheduler plane: an injected
+  never-drained pool is caught within 2x ``watchdog_stall_ms`` with
+  exactly one flight record, an idle-but-healthy pool never trips
+  (zero false positives), recovery clears the episode and /health;
+* **flight recorder** — dump round-trip: the companion ``.pbp`` is
+  readable by ``tools/trace_reader`` and the JSON parses with the
+  attributed trigger;
+* **reconciler** — push-mode rounds with zero HTTP fetches, partial
+  rounds that skip only the missing ranks, and the legacy
+  flat-dict ``_scrape`` monkeypatch contract staying intact;
+* **2-OS-rank leg** — the acceptance program
+  (:mod:`parsec_tpu.serving.harness`): pushed rollup equals the
+  per-rank registry truth, push-mode reconciler with zero fetches,
+  forced stall -> one attributed flight record.
+"""
+
+import functools
+import glob
+import json
+import math
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from parsec_tpu.comm.pttel import (TEL_STATS, TelemetryPlane, fold_entry,
+                                   gauge_key, merge_rank_hists, mesh_sum,
+                                   tel_children, tel_depth, tel_parent)
+from parsec_tpu.utils import mca
+
+
+# ------------------------------------------------------------- tree shape
+
+@pytest.mark.parametrize("nb_ranks,fanout", [(2, 2), (8, 2), (8, 4),
+                                             (13, 3), (64, 2), (1, 2)])
+def test_tree_parent_children_inverse(nb_ranks, fanout):
+    assert tel_parent(0, fanout) is None
+    seen = set()
+    for r in range(nb_ranks):
+        kids = tel_children(r, nb_ranks, fanout)
+        assert len(kids) <= fanout
+        for c in kids:
+            assert tel_parent(c, fanout) == r
+            assert c not in seen
+            seen.add(c)
+    # every non-root rank is exactly one rank's child
+    assert seen == set(range(1, nb_ranks))
+    depth = tel_depth(nb_ranks, fanout)
+    if nb_ranks > 1:
+        assert depth <= math.ceil(math.log(nb_ranks, fanout)) + 1
+        # walking any rank to the root takes <= depth hops
+        for r in range(nb_ranks):
+            hops, cur = 0, r
+            while cur != 0:
+                cur = tel_parent(cur, fanout)
+                hops += 1
+            assert hops <= depth
+    else:
+        assert depth == 0
+
+
+# -------------------------------------------------------------- fold math
+
+def test_fold_entry_telescopes_and_dedups():
+    store = {}
+    assert fold_entry(store, {"r": 3, "seq": 1, "ts": 10.0,
+                              "d": {"a": 5, "g": 2.5}})
+    # replaying the SAME entry is a no-op (idempotence)
+    snap = {r: dict(st["counters"]) for r, st in store.items()}
+    assert not fold_entry(store, {"r": 3, "seq": 1, "ts": 10.0,
+                                  "d": {"a": 5, "g": 2.5}})
+    assert {r: dict(st["counters"]) for r, st in store.items()} == snap
+    # a stale seq is dropped even with different content
+    assert not fold_entry(store, {"r": 3, "seq": 0, "ts": 9.0,
+                                  "d": {"a": 100}})
+    assert store[3]["counters"]["a"] == 5
+    # telescoping: cumulative == sum of deltas == latest snapshot value
+    assert fold_entry(store, {"r": 3, "seq": 2, "ts": 11.0,
+                              "d": {"a": -2, "g": 0.5}})
+    assert store[3]["counters"]["a"] == 3
+    assert store[3]["counters"]["g"] == pytest.approx(3.0)
+    assert store[3]["seq"] == 2 and store[3]["ts"] == 11.0
+
+
+def test_mesh_sum_excludes_gauges():
+    assert gauge_key("sched.hist.queue_ns.p99_us")
+    assert not gauge_key("sched.hist.queue_ns.count")
+    assert gauge_key("comm.clock_offset_ns")
+    assert not gauge_key("ptfab.served.tv")
+    store = {}
+    fold_entry(store, {"r": 0, "seq": 1, "ts": 1.0,
+                       "d": {"ptfab.served.tv": 10,
+                             "x.hist.lat.p99_us": 400.0}})
+    fold_entry(store, {"r": 1, "seq": 1, "ts": 1.0,
+                       "d": {"ptfab.served.tv": 7,
+                             "x.hist.lat.p99_us": 900.0}})
+    total = mesh_sum(store)
+    assert total["ptfab.served.tv"] == 17
+    assert "x.hist.lat.p99_us" not in total     # summed p99s lie
+    # but the gauge stays visible in the per-rank columns
+    assert store[1]["counters"]["x.hist.lat.p99_us"] == 900.0
+
+
+def test_merge_rank_hists_equals_per_rank_sums():
+    h0 = {"dtd.task_ns": [4, 1000, [[3, 2], [5, 2]]]}
+    h1 = {"dtd.task_ns": [3, 700, [[3, 1], [9, 2]]],
+          "sched.queue_ns": [1, 50, [[0, 1]]]}
+    merged = merge_rank_hists([h0, h1])
+    count, sum_ns, buckets = merged["dtd.task_ns"]
+    assert count == 7 and sum_ns == 1700
+    assert buckets == [[3, 3], [5, 2], [9, 2]]
+    assert sum(c for _, c in buckets) == count
+    assert merged["sched.queue_ns"] == [1, 50, [[0, 1]]]
+
+
+# ------------------------------------------- in-process 8-rank mesh (tree)
+
+def _mesh(nb_ranks, fanout):
+    """Real TelemetryPlanes over the in-process thread fabric, with
+    per-rank frame counting wrapped around send_am."""
+    from parsec_tpu.comm.engine import TAG_PTTEL
+    from parsec_tpu.comm.threads import ThreadFabric, ThreadsCE
+    fabric = ThreadFabric(nb_ranks)
+    mca.set("tel_interval_ms", 10_000)   # never self-fires; rounds manual
+    mca.set("tel_fanout", fanout)
+    planes, tx = [], [0] * nb_ranks
+    for r in range(nb_ranks):
+        ce = ThreadsCE(fabric, r)
+        orig = ce.send_am
+
+        def counted(tag, dst, header, payload=None, _o=orig, _r=r):
+            if tag == TAG_PTTEL:
+                tx[_r] += 1
+            return _o(tag, dst, header, payload)
+
+        ce.send_am = counted
+        plane = TelemetryPlane(SimpleNamespace(ce=ce))
+        ce.tag_register(TAG_PTTEL,
+                        lambda _ce, src, hdr, _p, pl=plane:
+                        pl.on_frame(src, hdr))
+        planes.append(plane)
+    return planes, tx
+
+
+def _sweep(planes):
+    """One mesh round, leaves-first with progress between ranks, so a
+    leaf's entry reaches the root within tree-depth sweeps (and in ONE
+    sweep at this deterministic ordering)."""
+    for p in sorted(planes, key=lambda p: -p.my_rank):
+        p.round()
+        for q in planes:
+            q.ce.progress()
+
+
+def test_eight_rank_mesh_converges_with_log_frames():
+    from parsec_tpu.utils.counters import counters
+    nb, fanout = 8, 2
+    before = TEL_STATS.snapshot()
+    counters.set("pttel_test.marker", 5)
+    planes, tx = _mesh(nb, fanout)
+    try:
+        _sweep(planes)
+        root = planes[0]
+        # the deterministic leaves-first ordering converges in ONE sweep
+        assert sorted(root.rollup()["ranks"]) == list(range(nb))
+        # delta correctness under CHANGE: the marker moves between
+        # rounds; the telescoped cumulative must equal the latest value,
+        # not the sum of snapshots
+        counters.set("pttel_test.marker", 12)
+        _sweep(planes)
+        _sweep(planes)
+        roll = root.rollup()
+        for r in range(nb):
+            assert roll["ranks"][r]["counters"]["pttel_test.marker"] == 12
+        assert roll["rollup"]["pttel_test.marker"] == 12 * nb
+        assert roll["depth"] == tel_depth(nb, fanout) == 3
+        for r in range(nb):
+            assert 0 <= roll["ranks"][r]["staleness_s"] < 60
+        # O(log P) frame shape: every rank sent AT MOST one frame per
+        # round (the root none), mesh-wide (P-1) frames per round
+        rounds = 3
+        assert tx[0] == 0
+        for r in range(1, nb):
+            assert 1 <= tx[r] <= rounds
+        assert sum(tx) == (nb - 1) * rounds
+        d = TEL_STATS.delta(before)
+        assert d["frames_tx"] == sum(tx)
+        assert d["frames_rx"] == sum(tx)     # every frame delivered once
+        assert d["tx_errors"] == 0
+    finally:
+        mca.set("tel_interval_ms", 0)
+
+
+def test_wire_frame_replay_is_idempotent():
+    """A duplicated TAG_PTTEL frame (transport retry) must not
+    double-count: replay the exact frame the leaf sent."""
+    from parsec_tpu.comm.engine import TAG_PTTEL
+    planes, _tx = _mesh(2, 2)
+    try:
+        captured = []
+        leaf, root = planes[1], planes[0]
+        orig = leaf.ce.send_am
+
+        def capture(tag, dst, header, payload=None):
+            if tag == TAG_PTTEL:
+                captured.append((dst, header))
+            return orig(tag, dst, header, payload)
+
+        leaf.ce.send_am = capture
+        leaf.round()
+        root.ce.progress()
+        assert captured
+        cum = dict(root._store[1]["counters"])
+        drops = TEL_STATS["late_drops"]
+        root.on_frame(1, captured[-1][1])      # replay verbatim
+        assert dict(root._store[1]["counters"]) == cum
+        assert TEL_STATS["late_drops"] > drops
+    finally:
+        mca.set("tel_interval_ms", 0)
+
+
+# --------------------------------------------------------------- watchdog
+
+@pytest.fixture
+def plane_ctx():
+    from parsec_tpu.core.context import Context
+    ctx = Context(nb_cores=1)
+    if ctx.sched_plane is None:
+        ctx.fini()
+        pytest.skip("native scheduler plane unavailable")
+    yield ctx
+    ctx.fini()
+
+
+def test_watchdog_idle_pool_never_trips(plane_ctx):
+    from parsec_tpu.core.watchdog import WATCHDOG_STATS, StallWatchdog
+    sp = plane_ctx.sched_plane
+    h = sp.register_pool("idle-pool", sp.KIND_EXT, weight=1, window=0)
+    assert h >= 0
+    wd = StallWatchdog(plane_ctx, stall_ms=40)
+    before = WATCHDOG_STATS.snapshot()
+    try:
+        for _ in range(6):                 # well past the threshold
+            wd.tick()
+            time.sleep(0.02)
+        d = WATCHDOG_STATS.delta(before)
+        assert d["pool_stalls"] == 0 and d["comm_stalls"] == 0 \
+            and d["device_stalls"] == 0
+        assert wd.active_stalls() == []
+    finally:
+        wd.stop()
+        sp.unregister_pool(h)
+
+
+def test_watchdog_catches_injected_stall_and_recovers(plane_ctx, tmp_path):
+    from parsec_tpu.core.watchdog import (WATCHDOG_STATS, StallWatchdog,
+                                          health_report)
+    from parsec_tpu.tools import flight
+    mca.set("flight_dir", str(tmp_path))
+    flight.reset()
+    sp = plane_ctx.sched_plane
+    h = sp.register_pool("stuck-pool", sp.KIND_EXT, weight=1, window=0)
+    assert h >= 0
+    sp.admit(h, 3)                        # held work that never drains
+    stall_ms = 60
+    wd = StallWatchdog(plane_ctx, stall_ms=stall_ms)
+    before = WATCHDOG_STATS.snapshot()
+    t0 = time.monotonic()
+    try:
+        detected = None
+        while time.monotonic() - t0 < 2 * stall_ms / 1e3 + 0.5:
+            wd.tick()
+            if WATCHDOG_STATS["pool_stalls"] > before["pool_stalls"]:
+                detected = (time.monotonic() - t0) * 1e3
+                break
+            time.sleep(stall_ms / 1e3 / 8)
+        assert detected is not None, "stall never detected"
+        assert detected <= 2 * stall_ms + 500   # 2x bound (+ tick slack)
+        stalls = wd.active_stalls()
+        assert any(s["lane"] == "pool:stuck-pool" for s in stalls)
+        hr = health_report()
+        assert hr is not None and hr["degraded"]
+        # exactly ONE attributed flight record, however long it persists
+        for _ in range(4):
+            wd.tick()
+            time.sleep(stall_ms / 1e3 / 4)
+        records = glob.glob(str(tmp_path / "flight-r*-*.json"))
+        assert len(records) == 1, records
+        body = json.loads(open(records[0]).read())
+        assert body["trigger"] == "watchdog_stall"
+        assert body["detail"]["lane"] == "pool:stuck-pool"
+        # recovery: progress resumes -> episode clears, /health restores
+        sp.retired(h, 3)
+        wd.tick()
+        d = WATCHDOG_STATS.delta(before)
+        assert d["pool_stalls"] == 1 and d["clears"] >= 1
+        assert wd.active_stalls() == []
+        assert not health_report()["degraded"]
+    finally:
+        wd.stop()
+        sp.unregister_pool(h)
+        mca.set("flight_dir", "")
+
+
+# --------------------------------------------------------- flight recorder
+
+def test_flight_dump_round_trips(tmp_path):
+    from parsec_tpu.tools import flight
+    from parsec_tpu.tools.trace_reader import read_pbp
+    from parsec_tpu.utils.trace import EVENT_FLAG_POINT, Profiling
+    flight.reset()
+    prof = Profiling()
+    kb, ke = prof.add_dictionary_keyword("unit::work")
+    st = prof.stream("worker-0")
+    for i in range(5):
+        st.trace(kb, i, 1, 0)
+        st.trace(ke, i, 1, 0)
+    st.trace(kb, 99, 1, EVENT_FLAG_POINT)
+    ctx = SimpleNamespace(profiling=prof, my_rank=3, comm=None,
+                          _ntrace=None)
+    path = flight.record("unit_test", {"why": "round-trip"},
+                         key="unit", ctx=ctx, dir=str(tmp_path))
+    assert path is not None and os.path.exists(path)
+    body = json.loads(open(path).read())
+    assert body["trigger"] == "unit_test" and body["rank"] == 3
+    assert body["detail"] == {"why": "round-trip"}
+    assert isinstance(body["counters"], dict)
+    assert body["events"] == 11
+    # companion .pbp reads back through the standard trace reader with
+    # the dictionary numbering intact
+    trace = read_pbp(os.path.join(str(tmp_path), body["trace"]))
+    assert [d["name"] for d in trace.dictionary] == ["unit::work"]
+    assert trace.streams[0]["name"] == "worker-0"
+    assert len(trace.streams[0]["events"]) == 11
+    # same key never dumps twice; a fresh key does (bounded count)
+    assert flight.record("unit_test", {}, key="unit", ctx=ctx,
+                         dir=str(tmp_path)) is None
+    assert flight.record("other", {}, key="other", ctx=ctx,
+                         dir=str(tmp_path)) is not None
+    flight.reset()
+
+
+def test_flight_unarmed_is_counted_noop(tmp_path):
+    from parsec_tpu.tools import flight
+    flight.reset()
+    before = flight.FLIGHT_STATS.snapshot()
+    assert mca.get("flight_dir", "") == ""
+    assert flight.record("x", {}) is None
+    d = flight.FLIGHT_STATS.delta(before)
+    assert d["triggers"] == 1 and d["suppressed"] == 1 and d["dumps"] == 0
+
+
+# -------------------------------------------------------------- reconciler
+
+class _StubFab:
+    nb_ranks = 2
+    my_rank = 0
+    rde = None
+    _dead: set = set()
+
+    def __init__(self):
+        self.weights = {}
+
+    def set_weight(self, t, w):
+        self.weights[t] = w
+
+
+def _mk_rec(**kw):
+    from parsec_tpu.serving.reconcile import ShareReconciler
+    kw.setdefault("tel", None)
+    return ShareReconciler(_StubFab(), [], {"a": 1.0, "b": 1.0}, **kw)
+
+
+def test_reconcile_partial_round_skips_missing_rank():
+    from parsec_tpu.serving.reconcile import RECONCILE_STATS
+    rec = _mk_rec()
+    feeds = [({0: {"a": 0, "b": 0}, 1: {"a": 0, "b": 0}}, set()),
+             ({0: {"a": 64, "b": 16}}, {1}),             # rank 1 dark
+             ({0: {"a": 128, "b": 32}, 1: {"a": 80, "b": 80}}, set())]
+    rec._scrape = lambda: feeds.pop(0)
+    before = RECONCILE_STATS.snapshot()
+    assert rec.step() is None          # first round only seeds _last
+    err = rec.step()                   # partial: reconciled over rank 0
+    assert err is not None and err > 0
+    assert rec._last[1] == {"a": 0, "b": 0}    # kept, not dropped
+    err2 = rec.step()                  # rank 1 back: delta spans the gap
+    assert err2 is not None
+    d = RECONCILE_STATS.delta(before)
+    assert d["partial_rounds"] == 1 and d["missing_ranks"] == 1
+    assert rec.rounds == 2
+
+
+def test_reconcile_push_mode_zero_http_fetches():
+    from parsec_tpu.serving.reconcile import RECONCILE_STATS
+    served = {"n": 0}
+
+    class _FakeTel:
+        interval_s = 0.01
+
+        def rollup(self):
+            served["n"] += 64
+            now = time.time()
+            return {"ranks": {
+                r: {"seq": served["n"], "ts": now, "staleness_s": 0.0,
+                    "counters": {"ptfab.served.a": served["n"],
+                                 "ptfab.served.b": served["n"]}}
+                for r in range(2)}}
+
+    rec = _mk_rec(tel=_FakeTel())
+    before = RECONCILE_STATS.snapshot()
+    rec.step()
+    assert rec.step() is not None
+    d = RECONCILE_STATS.delta(before)
+    assert d["push_rounds"] == 2 and d["http_fetches"] == 0 \
+        and d["scrape_rounds"] == 0
+    assert rec.last_mode == "push"
+    assert rec.converged_round is not None     # equal shares, weights 1:1
+
+
+def test_reconcile_push_stale_rank_counts_missing():
+    from parsec_tpu.serving.reconcile import RECONCILE_STATS
+
+    class _StaleTel:
+        interval_s = 0.01
+
+        def rollup(self):
+            now = time.time()
+            return {"ranks": {
+                0: {"seq": 1, "ts": now, "staleness_s": 0.0,
+                    "counters": {"ptfab.served.a": 100,
+                                 "ptfab.served.b": 100}},
+                1: {"seq": 1, "ts": now - 99, "staleness_s": 99.0,
+                    "counters": {"ptfab.served.a": 5,
+                                 "ptfab.served.b": 5}}}}
+
+    rec = _mk_rec(tel=_StaleTel())
+    before = RECONCILE_STATS.snapshot()
+    got = rec._scrape()
+    assert got is not None
+    per_rank, missing = got
+    assert 0 in per_rank and missing == {1}
+    assert RECONCILE_STATS.delta(before)["push_rounds"] == 1
+
+
+def test_reconcile_legacy_flat_scrape_still_works():
+    """The test_costmodel monkeypatch contract: a flat {tenant: total}
+    _scrape keeps driving step() unchanged."""
+    rec = _mk_rec()
+    feeds = [{"a": 0, "b": 0}, {"a": 90, "b": 30}]
+    rec._scrape = lambda: feeds.pop(0)
+    assert rec.step() is None
+    err = rec.step()
+    assert err is not None and err > 0
+    assert rec.rounds == 1
+    assert rec.fabric.weights          # nudges applied locally
+
+
+# ------------------------------------------------------------ 2-OS-rank leg
+
+def test_two_rank_pttel_push_and_stall(tmp_path):
+    """The acceptance leg: real processes, real wire. The pushed rollup
+    at rank 0 must equal each rank's own registry truth for every
+    ptfab.served.* counter; the push-mode reconciler must issue ZERO
+    HTTP fetches; the forced stall on rank 1 must produce exactly one
+    attributed flight record while rank 0's watchdog stays clean."""
+    from parsec_tpu.comm.tcp import run_distributed_procs
+    from parsec_tpu.serving.harness import pttel_2rank_program
+    res = run_distributed_procs(
+        2, functools.partial(pttel_2rank_program, stall=True,
+                             flight_dir=str(tmp_path)), timeout=300)
+    for r in res:
+        if not r.get("telemetry"):
+            pytest.skip(f"telemetry leg unavailable: {r.get('reason')}")
+    r0, r1 = res
+    # --- pushed rollup == per-rank truth, within one settled round ---
+    assert sorted(r0["ranks_seen"]) == [0, 1]
+    for rank, r in enumerate(res):
+        assert r["served_local"], "no served counters registered"
+        assert r0["per_rank_served"][rank] == r["served_local"], \
+            (rank, r0["per_rank_served"][rank], r["served_local"])
+    for k in r0["per_rank_served"][0]:
+        assert r0["rollup_served"][k] == sum(
+            r0["per_rank_served"][r].get(k, 0) for r in (0, 1))
+    assert all(s < 30 for s in r0["staleness_s"].values())
+    # --- O(log P) wire shape + clean frames --------------------------
+    assert r1["tel_stats"]["frames_tx"] > 0       # leaf pushed
+    assert r0["tel_stats"]["frames_rx"] > 0       # root folded
+    assert r0["tel_stats"]["frames_tx"] == 0      # the root sends none
+    for r in res:
+        assert r["tel_stats"]["rounds"] > 0
+        assert r["tel_stats"]["tx_errors"] == 0
+        assert r["frame_errors"] == 0
+    # --- push-mode reconciler: zero per-round HTTP fetches -----------
+    assert r0["reconcile_mode"] == "push"
+    assert r0["reconcile"]["push_rounds"] > 0
+    assert r0["reconcile"]["http_fetches"] == 0
+    # --- forced stall: one attributed record, clean elsewhere --------
+    assert r0["watchdog_armed"] and r1["watchdog_armed"]
+    st = r1["stall"]
+    assert st["watchdog"]["pool_stalls"] == 1, st
+    assert st["detected_ms"] <= 2 * 500, st       # 2x watchdog_stall_ms
+    assert st["flight_records"] == 1, st
+    assert r0["watchdog_stats"]["pool_stalls"] == 0
+    assert r0["watchdog_stats"]["device_stalls"] == 0
+    records = glob.glob(str(tmp_path / "flight-r1-*.json"))
+    assert len(records) == 1
+    body = json.loads(open(records[0]).read())
+    assert body["trigger"] == "watchdog_stall"
+    assert body["detail"]["lane"] == "pool:stall-inject"
